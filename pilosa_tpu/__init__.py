@@ -1,0 +1,36 @@
+"""pilosa_tpu — a TPU-native distributed bitmap-index framework.
+
+A ground-up re-design of the capabilities of Pilosa (reference:
+``princessd8251/pilosa``, a fork of the Go ``pilosa/pilosa`` distributed
+roaring-bitmap index) for TPU hardware:
+
+- fragments are dense packed bit-matrices (``uint32[rows, ShardWidth/32]``)
+  laid out across a ``jax.sharding.Mesh`` instead of per-node Go roaring heaps;
+- container set-ops / popcounts lower to XLA/Pallas bitwise kernels instead of
+  the reference's CPU hot loops (reference: roaring/roaring.go);
+- cross-shard aggregation is a ``psum`` over ICI inside one jitted program
+  instead of HTTP scatter-gather (reference: executor.go mapReduce);
+- roaring remains the at-rest / interchange format, implemented host-side
+  (numpy + optional C++ accelerator).
+
+Layer map mirrors SURVEY.md §2:
+    roaring/   L0 bitmap engine (host codec + oracle)
+    core/      L1 storage & data model (Holder/Index/Field/View/Fragment)
+    pql/       L2 query language (parser → AST)
+    executor/  L2 query execution (AST → jitted device programs)
+    parallel/  L3 mesh/topology (device mesh + cluster partitioning)
+    server/    L5/L6 API façade, HTTP transport, server runtime
+    ops/       TPU kernel library (the "native" hot loops)
+    utils/     X1 cross-cutting (stats, tracing, config, logging)
+"""
+
+from pilosa_tpu.shardwidth import SHARD_WIDTH, SHARD_WIDTH_EXP, WORDS_PER_SHARD
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "SHARD_WIDTH",
+    "SHARD_WIDTH_EXP",
+    "WORDS_PER_SHARD",
+    "__version__",
+]

@@ -1,0 +1,38 @@
+"""L0 bitmap engine: host-side roaring codec, persistence, dense packing.
+
+Reference: roaring/ (roaring.go, btree.go). On TPU the hot ops run on
+dense packed words (see ``pilosa_tpu.ops``); this package is the at-rest
+format, import/export interchange, CPU oracle, and host baseline.
+"""
+
+from pilosa_tpu.roaring.bitmap import Bitmap
+from pilosa_tpu.roaring.containers import Container
+from pilosa_tpu.roaring.pack import (
+    pack_positions,
+    pack_range,
+    unpack_words,
+    words_count,
+)
+from pilosa_tpu.roaring.serialize import (
+    OP_ADD,
+    OP_REMOVE,
+    append_op,
+    deserialize,
+    replay_ops,
+    serialize,
+)
+
+__all__ = [
+    "Bitmap",
+    "Container",
+    "pack_positions",
+    "pack_range",
+    "unpack_words",
+    "words_count",
+    "serialize",
+    "deserialize",
+    "append_op",
+    "replay_ops",
+    "OP_ADD",
+    "OP_REMOVE",
+]

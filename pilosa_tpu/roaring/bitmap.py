@@ -1,0 +1,209 @@
+"""64-bit roaring Bitmap (host side).
+
+Reference: roaring/roaring.go (Bitmap) + roaring/btree.go — upstream keys a
+B-tree of containers by the high 48 bits of each value. Here a plain dict
+(Python dicts are hash maps with O(1) lookup; sorted key order is produced
+on demand) maps ``key = value >> 16`` → Container.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Iterator
+
+import numpy as np
+
+from pilosa_tpu.roaring import containers as ct
+
+_KEY_SHIFT = np.uint64(16)
+_LOW_MASK = np.uint64(0xFFFF)
+
+
+class Bitmap:
+    """A set of uint64 values stored as roaring containers."""
+
+    __slots__ = ("_containers",)
+
+    def __init__(self) -> None:
+        self._containers: dict[int, ct.Container] = {}
+
+    # ---------------------------------------------------------------- builders
+    @classmethod
+    def from_values(cls, values: Iterable[int] | np.ndarray) -> "Bitmap":
+        b = cls()
+        b.add_many(np.asarray(list(values) if not isinstance(values, np.ndarray) else values, dtype=np.uint64))
+        return b
+
+    def copy(self) -> "Bitmap":
+        b = Bitmap()
+        b._containers = {k: ct.Container(c.type, c.data.copy()) for k, c in self._containers.items()}
+        return b
+
+    # ---------------------------------------------------------------- mutation
+    def add(self, v: int) -> bool:
+        key, low = int(v) >> 16, int(v) & 0xFFFF
+        c = self._containers.get(key)
+        if c is None:
+            self._containers[key] = ct.array_container(np.array([low], dtype=np.uint16))
+            return True
+        nc, changed = ct.container_add(c, low)
+        if changed:
+            self._containers[key] = nc
+        return changed
+
+    def remove(self, v: int) -> bool:
+        key, low = int(v) >> 16, int(v) & 0xFFFF
+        c = self._containers.get(key)
+        if c is None:
+            return False
+        nc, changed = ct.container_remove(c, low)
+        if changed:
+            if ct.container_count(nc) == 0:
+                del self._containers[key]
+            else:
+                self._containers[key] = nc
+        return changed
+
+    def add_many(self, values: np.ndarray) -> None:
+        """Vectorised bulk add: sort, dedupe, group by container key."""
+        if values.size == 0:
+            return
+        values = np.unique(values.astype(np.uint64))
+        keys = (values >> _KEY_SHIFT).astype(np.int64)
+        lows = (values & _LOW_MASK).astype(np.uint16)
+        uniq_keys, starts = np.unique(keys, return_index=True)
+        bounds = np.append(starts, keys.size)
+        for i, key in enumerate(uniq_keys):
+            chunk = lows[bounds[i] : bounds[i + 1]]
+            key = int(key)
+            existing = self._containers.get(key)
+            if existing is None:
+                self._containers[key] = ct.from_values(chunk)
+            else:
+                self._containers[key] = ct.container_or(existing, ct.from_values(chunk))
+
+    def remove_many(self, values: np.ndarray) -> None:
+        if values.size == 0:
+            return
+        values = np.unique(values.astype(np.uint64))
+        keys = (values >> _KEY_SHIFT).astype(np.int64)
+        lows = (values & _LOW_MASK).astype(np.uint16)
+        uniq_keys, starts = np.unique(keys, return_index=True)
+        bounds = np.append(starts, keys.size)
+        for i, key in enumerate(uniq_keys):
+            key = int(key)
+            existing = self._containers.get(key)
+            if existing is None:
+                continue
+            chunk = lows[bounds[i] : bounds[i + 1]]
+            nc = ct.container_andnot(existing, ct.from_values(chunk))
+            if ct.container_count(nc) == 0:
+                del self._containers[key]
+            else:
+                self._containers[key] = nc
+
+    # ----------------------------------------------------------------- queries
+    def contains(self, v: int) -> bool:
+        c = self._containers.get(int(v) >> 16)
+        return c is not None and ct.container_contains(c, int(v) & 0xFFFF)
+
+    def count(self) -> int:
+        return sum(ct.container_count(c) for c in self._containers.values())
+
+    def values(self) -> np.ndarray:
+        """All values, sorted ascending, as uint64."""
+        if not self._containers:
+            return np.empty(0, dtype=np.uint64)
+        parts = []
+        for key in sorted(self._containers):
+            vals = ct.as_values(self._containers[key]).astype(np.uint64)
+            parts.append(vals + (np.uint64(key) << _KEY_SHIFT))
+        return np.concatenate(parts)
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.values().tolist())
+
+    def __len__(self) -> int:
+        return self.count()
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Bitmap):
+            return NotImplemented
+        return np.array_equal(self.values(), other.values())
+
+    def min(self) -> int:
+        if not self._containers:
+            raise ValueError("empty bitmap")
+        key = min(self._containers)
+        return (key << 16) | int(ct.as_values(self._containers[key])[0])
+
+    def max(self) -> int:
+        if not self._containers:
+            raise ValueError("empty bitmap")
+        key = max(self._containers)
+        return (key << 16) | int(ct.as_values(self._containers[key])[-1])
+
+    def range_count(self, start: int, stop: int) -> int:
+        """Count of values in [start, stop)."""
+        total = 0
+        for key in self._containers:
+            base = key << 16
+            if base >= stop or base + ct.CONTAINER_BITS <= start:
+                continue
+            c = self._containers[key]
+            if start <= base and base + ct.CONTAINER_BITS <= stop:
+                total += ct.container_count(c)
+            else:
+                vals = ct.as_values(c).astype(np.uint64) + np.uint64(base)
+                total += int(
+                    np.count_nonzero(
+                        (vals >= np.uint64(start)) & (vals < np.uint64(stop))
+                    )
+                )
+        return total
+
+    def range_values(self, start: int, stop: int) -> np.ndarray:
+        """Values in [start, stop), sorted, as uint64 (absolute positions)."""
+        parts = []
+        for key in sorted(self._containers):
+            base = key << 16
+            if base >= stop or base + ct.CONTAINER_BITS <= start:
+                continue
+            vals = ct.as_values(self._containers[key]).astype(np.uint64) + np.uint64(base)
+            if start > base or base + ct.CONTAINER_BITS > stop:
+                vals = vals[(vals >= np.uint64(start)) & (vals < np.uint64(stop))]
+            parts.append(vals)
+        if not parts:
+            return np.empty(0, dtype=np.uint64)
+        return np.concatenate(parts)
+
+    # ------------------------------------------------------------------ setops
+    def _zipped(self, other: "Bitmap", keys: Iterable[int], op) -> "Bitmap":
+        out = Bitmap()
+        empty = ct.array_container(np.empty(0, dtype=np.uint16))
+        for key in keys:
+            a = self._containers.get(key, empty)
+            b = other._containers.get(key, empty)
+            c = op(a, b)
+            if ct.container_count(c):
+                out._containers[key] = c
+        return out
+
+    def intersect(self, other: "Bitmap") -> "Bitmap":
+        keys = self._containers.keys() & other._containers.keys()
+        return self._zipped(other, keys, ct.container_and)
+
+    def union(self, other: "Bitmap") -> "Bitmap":
+        keys = self._containers.keys() | other._containers.keys()
+        return self._zipped(other, keys, ct.container_or)
+
+    def difference(self, other: "Bitmap") -> "Bitmap":
+        return self._zipped(other, self._containers.keys(), ct.container_andnot)
+
+    def xor(self, other: "Bitmap") -> "Bitmap":
+        keys = self._containers.keys() | other._containers.keys()
+        return self._zipped(other, keys, ct.container_xor)
+
+    __and__ = intersect
+    __or__ = union
+    __sub__ = difference
+    __xor__ = xor

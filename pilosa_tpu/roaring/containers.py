@@ -1,0 +1,233 @@
+"""Roaring containers over a 16-bit value space (host side, numpy).
+
+Re-implements the semantics of the reference's container layer
+(reference: roaring/roaring.go — Container, intersectArrayArray/ArrayBitmap/
+BitmapBitmap, unionRunRun, differenceBitmapRun, popcount helpers) with a
+numpy-first design rather than a port of the Go pairwise-typed loops:
+
+- ``array``  — sorted ``uint16[n]``, n <= 4096
+- ``bitmap`` — ``uint64[1024]`` (65,536 bits)
+- ``run``    — ``uint16[n, 2]`` inclusive [start, last] intervals, sorted
+
+Set operations normalise mixed-type operands to whichever representation
+vectorises best under numpy (the Go version hand-writes all 9 type pairs;
+on host we only need this codec to be a correct oracle and a reasonably
+fast CPU baseline — the hot path is the TPU packed-dense kernels in
+``pilosa_tpu.ops``).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+ARRAY_MAX = 4096  # max cardinality for an array container (same as reference)
+BITMAP_N = 1024  # uint64 words per bitmap container
+CONTAINER_BITS = 1 << 16
+
+TYPE_ARRAY = 1
+TYPE_BITMAP = 2
+TYPE_RUN = 3
+
+_EMPTY_U16 = np.empty(0, dtype=np.uint16)
+
+
+class Container:
+    """One roaring container: (type tag, numpy payload)."""
+
+    __slots__ = ("type", "data")
+
+    def __init__(self, ctype: int, data: np.ndarray):
+        self.type = ctype
+        self.data = data
+
+    def __repr__(self) -> str:
+        name = {TYPE_ARRAY: "array", TYPE_BITMAP: "bitmap", TYPE_RUN: "run"}[self.type]
+        return f"<Container {name} n={container_count(self)}>"
+
+
+def array_container(values: np.ndarray) -> Container:
+    return Container(TYPE_ARRAY, np.asarray(values, dtype=np.uint16))
+
+
+def bitmap_container(words: np.ndarray) -> Container:
+    return Container(TYPE_BITMAP, np.asarray(words, dtype=np.uint64))
+
+
+def run_container(runs: np.ndarray) -> Container:
+    return Container(TYPE_RUN, np.asarray(runs, dtype=np.uint16).reshape(-1, 2))
+
+
+def from_values(values: np.ndarray) -> Container:
+    """Build the best-typed container from sorted-unique uint16 values."""
+    values = np.asarray(values, dtype=np.uint16)
+    if values.size > ARRAY_MAX:
+        return optimize(bitmap_container(_values_to_words(values)))
+    return optimize(array_container(values))
+
+
+def _values_to_words(values: np.ndarray) -> np.ndarray:
+    words = np.zeros(BITMAP_N, dtype=np.uint64)
+    v = values.astype(np.uint64)
+    np.bitwise_or.at(words, (v >> np.uint64(6)), np.uint64(1) << (v & np.uint64(63)))
+    return words
+
+
+def _words_to_values(words: np.ndarray) -> np.ndarray:
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    return np.flatnonzero(bits).astype(np.uint16)
+
+
+def _runs_to_values(runs: np.ndarray) -> np.ndarray:
+    if runs.size == 0:
+        return _EMPTY_U16
+    starts = runs[:, 0].astype(np.int64)
+    lasts = runs[:, 1].astype(np.int64)
+    lengths = lasts - starts + 1
+    total = int(lengths.sum())
+    # vectorised concatenation of aranges
+    out = np.repeat(starts - np.concatenate(([0], np.cumsum(lengths)[:-1])), lengths)
+    out = out + np.arange(total)
+    return out.astype(np.uint16)
+
+
+def _values_to_runs(values: np.ndarray) -> np.ndarray:
+    if values.size == 0:
+        return np.empty((0, 2), dtype=np.uint16)
+    v = values.astype(np.int64)
+    breaks = np.flatnonzero(np.diff(v) != 1)
+    starts = np.concatenate(([0], breaks + 1))
+    ends = np.concatenate((breaks, [v.size - 1]))
+    return np.stack([v[starts], v[ends]], axis=1).astype(np.uint16)
+
+
+def as_values(c: Container) -> np.ndarray:
+    """Sorted uint16 values in the container."""
+    if c.type == TYPE_ARRAY:
+        return c.data
+    if c.type == TYPE_BITMAP:
+        return _words_to_values(c.data)
+    return _runs_to_values(c.data)
+
+
+def as_words(c: Container) -> np.ndarray:
+    """uint64[1024] bitmap view of the container."""
+    if c.type == TYPE_BITMAP:
+        return c.data
+    if c.type == TYPE_ARRAY:
+        return _values_to_words(c.data)
+    # run → words: fill intervals
+    words = np.zeros(BITMAP_N, dtype=np.uint64)
+    if c.data.size:
+        words_u8 = np.zeros(BITMAP_N * 64, dtype=np.uint8)
+        for s, l in c.data.astype(np.int64):
+            words_u8[s : l + 1] = 1
+        words = np.packbits(words_u8, bitorder="little").view(np.uint64)
+    return words
+
+
+def container_count(c: Container) -> int:
+    if c.type == TYPE_ARRAY:
+        return int(c.data.size)
+    if c.type == TYPE_BITMAP:
+        return int(np.bitwise_count(c.data).sum())
+    if c.data.size == 0:
+        return 0
+    return int(
+        (c.data[:, 1].astype(np.int64) - c.data[:, 0].astype(np.int64) + 1).sum()
+    )
+
+
+def container_contains(c: Container, v: int) -> bool:
+    if c.type == TYPE_ARRAY:
+        i = int(np.searchsorted(c.data, np.uint16(v)))
+        return i < c.data.size and int(c.data[i]) == v
+    if c.type == TYPE_BITMAP:
+        return bool((int(c.data[v >> 6]) >> (v & 63)) & 1)
+    if c.data.size == 0:
+        return False
+    i = int(np.searchsorted(c.data[:, 0], np.uint16(v), side="right")) - 1
+    return i >= 0 and int(c.data[i, 0]) <= v <= int(c.data[i, 1])
+
+
+def container_add(c: Container, v: int) -> tuple[Container, bool]:
+    """Return (new container, changed)."""
+    if container_contains(c, v):
+        return c, False
+    if c.type == TYPE_ARRAY and c.data.size < ARRAY_MAX:
+        i = int(np.searchsorted(c.data, np.uint16(v)))
+        return array_container(np.insert(c.data, i, np.uint16(v))), True
+    words = as_words(c).copy()
+    words[v >> 6] |= np.uint64(1) << np.uint64(v & 63)
+    out = bitmap_container(words)
+    # re-optimize on a type transition so run/full-array containers stay
+    # compact under single-bit writes; an already-bitmap container stays
+    # bitmap without paying O(container) re-analysis per add
+    return (optimize(out) if c.type != TYPE_BITMAP else out), True
+
+
+def container_remove(c: Container, v: int) -> tuple[Container, bool]:
+    if not container_contains(c, v):
+        return c, False
+    if c.type == TYPE_ARRAY:
+        i = int(np.searchsorted(c.data, np.uint16(v)))
+        return array_container(np.delete(c.data, i)), True
+    words = as_words(c).copy()
+    words[v >> 6] &= ~(np.uint64(1) << np.uint64(v & 63))
+    return optimize(bitmap_container(words)), True
+
+
+def optimize(c: Container) -> Container:
+    """Convert to the smallest representation (reference: Container.optimize)."""
+    n = container_count(c)
+    if n == 0:
+        return array_container(_EMPTY_U16)
+    values = as_values(c)
+    runs = _values_to_runs(values)
+    # sizes in bytes: array 2n, bitmap 8192, run 4*len(runs)
+    run_sz, arr_sz = 4 * runs.shape[0], 2 * n
+    if run_sz < min(arr_sz, 8192):
+        return run_container(runs)
+    if n <= ARRAY_MAX:
+        return array_container(values)
+    return bitmap_container(as_words(c))
+
+
+def _binary_op(a: Container, b: Container, op: str) -> Container:
+    """Typed-pair dispatch collapsed to two fast paths: sorted-array merges
+    when both sides are arrays, uint64 word ops otherwise."""
+    if a.type == TYPE_ARRAY and b.type == TYPE_ARRAY:
+        if op == "and":
+            out = np.intersect1d(a.data, b.data, assume_unique=True)
+        elif op == "or":
+            out = np.union1d(a.data, b.data)
+        elif op == "xor":
+            out = np.setxor1d(a.data, b.data, assume_unique=True)
+        else:  # andnot
+            out = np.setdiff1d(a.data, b.data, assume_unique=True)
+        return from_values(out.astype(np.uint16))
+    wa, wb = as_words(a), as_words(b)
+    if op == "and":
+        w = wa & wb
+    elif op == "or":
+        w = wa | wb
+    elif op == "xor":
+        w = wa ^ wb
+    else:
+        w = wa & ~wb
+    return optimize(bitmap_container(w))
+
+
+def container_and(a: Container, b: Container) -> Container:
+    return _binary_op(a, b, "and")
+
+
+def container_or(a: Container, b: Container) -> Container:
+    return _binary_op(a, b, "or")
+
+
+def container_xor(a: Container, b: Container) -> Container:
+    return _binary_op(a, b, "xor")
+
+
+def container_andnot(a: Container, b: Container) -> Container:
+    return _binary_op(a, b, "andnot")

@@ -1,0 +1,147 @@
+"""Roaring persistence: snapshot file format + append-only ops log.
+
+Reference: roaring/roaring.go (WriteTo/UnmarshalBinary with the
+pilosa-specific cookie, and the appended ops log: op / OpWriter). The byte
+layout here is this framework's own (the reference mount was empty so
+byte-compatibility could not be verified — see SURVEY.md §0), but the
+structure mirrors the reference: a header cookie, per-container metadata
+(key, type, cardinality), offsets, payloads, then zero or more ops appended
+after the snapshot which are replayed on load.
+
+Layout (little-endian):
+    header:   uint16 magic=12348 | uint16 version=0 | uint32 n_containers
+    metadata: n × (uint64 key | uint16 type | uint16 pad | uint32 cardinality)
+    offsets:  n × uint32 (byte offset of payload from file start)
+    payloads: array: n×uint16; bitmap: 1024×uint64; run: n_runs×(2×uint16),
+              run payload prefixed by uint32 n_runs
+    ops log:  repeated (uint8 magic=0xF1 | uint8 opcode | uint32 count |
+              count × uint64 values) — opcode 1=add, 2=remove
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+
+import numpy as np
+
+from pilosa_tpu.roaring import containers as ct
+from pilosa_tpu.roaring.bitmap import Bitmap
+
+MAGIC = 12348
+VERSION = 0
+OP_MAGIC = 0xF1
+OP_ADD = 1
+OP_REMOVE = 2
+
+_HEADER = struct.Struct("<HHI")
+_META = struct.Struct("<QHHI")
+_OP_HEADER = struct.Struct("<BBI")
+
+
+def serialize(bitmap: Bitmap) -> bytes:
+    """Snapshot a Bitmap to bytes (no ops log)."""
+    keys = sorted(bitmap._containers)
+    buf = io.BytesIO()
+    buf.write(_HEADER.pack(MAGIC, VERSION, len(keys)))
+    payloads = []
+    for key in keys:
+        c = bitmap._containers[key]
+        if c.type == ct.TYPE_ARRAY:
+            payload = c.data.tobytes()
+        elif c.type == ct.TYPE_BITMAP:
+            payload = c.data.tobytes()
+        else:
+            payload = struct.pack("<I", c.data.shape[0]) + c.data.tobytes()
+        payloads.append(payload)
+        buf.write(_META.pack(key, c.type, 0, ct.container_count(c)))
+    offset = _HEADER.size + len(keys) * (_META.size + 4)
+    for payload in payloads:
+        buf.write(struct.pack("<I", offset))
+        offset += len(payload)
+    for payload in payloads:
+        buf.write(payload)
+    return buf.getvalue()
+
+
+def deserialize(data: bytes) -> tuple[Bitmap, int]:
+    """Parse a snapshot; returns (bitmap, bytes consumed by the snapshot).
+
+    Any bytes after the snapshot are expected to be ops-log records; use
+    ``replay_ops`` on the remainder.
+    """
+    try:
+        return _deserialize(data)
+    except struct.error as e:
+        raise ValueError(f"truncated roaring snapshot: {e}") from e
+
+
+def _deserialize(data: bytes) -> tuple[Bitmap, int]:
+    magic, version, n = _HEADER.unpack_from(data, 0)
+    if magic != MAGIC:
+        raise ValueError(f"bad roaring magic {magic}")
+    if version != VERSION:
+        raise ValueError(f"unsupported roaring version {version}")
+    b = Bitmap()
+    meta_off = _HEADER.size
+    metas = []
+    for i in range(n):
+        key, ctype, _pad, card = _META.unpack_from(data, meta_off + i * _META.size)
+        metas.append((key, ctype, card))
+    off_base = meta_off + n * _META.size
+    offsets = [
+        struct.unpack_from("<I", data, off_base + 4 * i)[0] for i in range(n)
+    ]
+    end = _HEADER.size + n * (_META.size + 4)
+    for (key, ctype, card), off in zip(metas, offsets):
+        if ctype == ct.TYPE_ARRAY:
+            size = card * 2
+            c = ct.array_container(np.frombuffer(data, np.uint16, card, off))
+        elif ctype == ct.TYPE_BITMAP:
+            size = ct.BITMAP_N * 8
+            c = ct.bitmap_container(np.frombuffer(data, np.uint64, ct.BITMAP_N, off))
+        elif ctype == ct.TYPE_RUN:
+            (n_runs,) = struct.unpack_from("<I", data, off)
+            size = 4 + n_runs * 4
+            c = ct.run_container(
+                np.frombuffer(data, np.uint16, n_runs * 2, off + 4).reshape(-1, 2)
+            )
+        else:
+            raise ValueError(f"bad container type {ctype}")
+        # copy payloads out of the input buffer so containers stay mutable
+        c = ct.Container(c.type, c.data.copy())
+        b._containers[key] = c
+        end = max(end, off + size)
+    return b, end
+
+
+def append_op(opcode: int, values: np.ndarray) -> bytes:
+    """Encode one ops-log record for appending to a fragment file."""
+    values = np.asarray(values, dtype=np.uint64)
+    return _OP_HEADER.pack(OP_MAGIC, opcode, values.size) + values.tobytes()
+
+
+def replay_ops(bitmap: Bitmap, data: bytes) -> int:
+    """Apply ops-log records to ``bitmap``; returns number of ops replayed.
+
+    Truncated trailing records (torn writes) are ignored, matching the
+    reference's crash-tolerant ops-log replay.
+    """
+    pos, n_ops = 0, 0
+    while pos + _OP_HEADER.size <= len(data):
+        magic, opcode, count = _OP_HEADER.unpack_from(data, pos)
+        if magic != OP_MAGIC:
+            break
+        body_end = pos + _OP_HEADER.size + count * 8
+        if body_end > len(data):
+            break  # torn write
+        values = np.frombuffer(data, np.uint64, count, pos + _OP_HEADER.size)
+        if opcode == OP_ADD:
+            bitmap.add_many(values)
+        elif opcode == OP_REMOVE:
+            bitmap.remove_many(values)
+        else:
+            break
+        pos = body_end
+        n_ops += 1
+    return n_ops

@@ -1,0 +1,30 @@
+"""Test harness configuration.
+
+Forces an 8-device virtual CPU platform (multi-chip sharding tests run on a
+``jax.sharding.Mesh`` over these, mirroring how the driver validates the
+multi-chip path) and a small shard width so fragment arrays stay tiny.
+Must set env vars BEFORE jax / pilosa_tpu are imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
+
+
+@pytest.fixture
+def tmp_holder_path(tmp_path):
+    return str(tmp_path / "holder")

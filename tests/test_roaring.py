@@ -1,0 +1,186 @@
+"""L0 roaring codec tests — property-tested against Python sets.
+
+Mirrors the reference's test strategy for roaring/ (roaring_internal_test.go:
+randomized container-op tests across all type pairs + serialization
+round-trips)."""
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import roaring
+from pilosa_tpu.roaring import containers as ct
+
+
+def random_values(rng, n, span):
+    return np.unique(rng.integers(0, span, size=n, dtype=np.uint64))
+
+
+# ---------------------------------------------------------------- containers
+@pytest.mark.parametrize("na,nb", [(10, 10), (10, 5000), (5000, 5000), (0, 100)])
+def test_container_ops_match_sets(rng, na, nb):
+    a = np.unique(rng.integers(0, 1 << 16, size=na, dtype=np.uint16)) if na else np.empty(0, np.uint16)
+    b = np.unique(rng.integers(0, 1 << 16, size=nb, dtype=np.uint16))
+    ca, cb = ct.from_values(a), ct.from_values(b)
+    sa, sb = set(a.tolist()), set(b.tolist())
+    assert set(ct.as_values(ct.container_and(ca, cb)).tolist()) == sa & sb
+    assert set(ct.as_values(ct.container_or(ca, cb)).tolist()) == sa | sb
+    assert set(ct.as_values(ct.container_xor(ca, cb)).tolist()) == sa ^ sb
+    assert set(ct.as_values(ct.container_andnot(ca, cb)).tolist()) == sa - sb
+
+
+def test_container_run_optimization():
+    # dense consecutive range should become a run container
+    c = ct.from_values(np.arange(10000, dtype=np.uint16))
+    assert c.type == ct.TYPE_RUN
+    assert ct.container_count(c) == 10000
+    assert ct.container_contains(c, 9999)
+    assert not ct.container_contains(c, 10000)
+
+
+def test_container_type_transitions():
+    c = ct.from_values(np.empty(0, np.uint16))
+    for v in range(0, 9000, 2):  # stride-2 defeats run encoding
+        c, changed = ct.container_add(c, v)
+        assert changed
+    assert c.type == ct.TYPE_BITMAP
+    assert ct.container_count(c) == 4500
+    c2, changed = ct.container_add(c, 0)
+    assert not changed and c2 is c
+
+
+# -------------------------------------------------------------------- bitmap
+def test_bitmap_add_remove_contains(rng):
+    b = roaring.Bitmap()
+    vals = random_values(rng, 500, 1 << 40)
+    for v in vals.tolist():
+        assert b.add(v)
+        assert not b.add(v)
+    assert b.count() == vals.size
+    assert np.array_equal(b.values(), vals)
+    for v in vals[:50].tolist():
+        assert b.contains(v)
+        assert b.remove(v)
+        assert not b.contains(v)
+        assert not b.remove(v)
+    assert b.count() == vals.size - 50
+
+
+def test_bitmap_add_many_matches_loop(rng):
+    vals = random_values(rng, 20000, 1 << 32)
+    b1 = roaring.Bitmap.from_values(vals)
+    b2 = roaring.Bitmap()
+    for v in vals[:1000].tolist():
+        b2.add(v)
+    assert b1.range_count(0, 1 << 33) == vals.size
+    assert set(b2.values().tolist()) <= set(b1.values().tolist())
+
+
+def test_bitmap_setops_match_sets(rng):
+    va = random_values(rng, 3000, 1 << 24)
+    vb = random_values(rng, 3000, 1 << 24)
+    a, b = roaring.Bitmap.from_values(va), roaring.Bitmap.from_values(vb)
+    sa, sb = set(va.tolist()), set(vb.tolist())
+    assert set((a & b).values().tolist()) == sa & sb
+    assert set((a | b).values().tolist()) == sa | sb
+    assert set((a - b).values().tolist()) == sa - sb
+    assert set((a ^ b).values().tolist()) == sa ^ sb
+
+
+def test_bitmap_range(rng):
+    vals = random_values(rng, 5000, 1 << 20)
+    b = roaring.Bitmap.from_values(vals)
+    lo, hi = 1 << 10, 1 << 18
+    expect = vals[(vals >= lo) & (vals < hi)]
+    assert b.range_count(lo, hi) == expect.size
+    assert np.array_equal(b.range_values(lo, hi), expect)
+    assert b.min() == int(vals.min())
+    assert b.max() == int(vals.max())
+
+
+# ------------------------------------------------------------- serialization
+def test_serialize_roundtrip(rng):
+    vals = np.concatenate(
+        [
+            random_values(rng, 2000, 1 << 16),  # array/bitmap containers
+            np.arange(1 << 20, (1 << 20) + 30000, dtype=np.uint64),  # run
+            random_values(rng, 100, 1 << 48),  # sparse high keys
+        ]
+    )
+    b = roaring.Bitmap.from_values(vals)
+    data = roaring.serialize(b)
+    b2, consumed = roaring.deserialize(data)
+    assert consumed == len(data)
+    assert b2 == b
+
+
+def test_ops_log_replay(rng):
+    b = roaring.Bitmap.from_values(random_values(rng, 1000, 1 << 20))
+    snapshot = roaring.serialize(b)
+    adds = random_values(rng, 200, 1 << 20)
+    removes = b.values()[:100]
+    log = roaring.append_op(roaring.OP_ADD, adds) + roaring.append_op(
+        roaring.OP_REMOVE, removes
+    )
+    expect = b.copy()
+    expect.add_many(adds)
+    expect.remove_many(removes)
+
+    loaded, consumed = roaring.deserialize(snapshot + log)
+    n = roaring.replay_ops(loaded, (snapshot + log)[consumed:])
+    assert n == 2
+    assert loaded == expect
+
+    # torn write at the tail is ignored
+    torn = snapshot + log + roaring.append_op(roaring.OP_ADD, adds)[:-3]
+    loaded2, consumed2 = roaring.deserialize(torn)
+    assert roaring.replay_ops(loaded2, torn[consumed2:]) == 2
+    assert loaded2 == expect
+
+
+# -------------------------------------------------------------------- packing
+def test_pack_unpack_roundtrip(rng):
+    vals = random_values(rng, 4000, 1 << 16)
+    b = roaring.Bitmap.from_values(vals)
+    words = roaring.pack_range(b, 0, 1 << 16)
+    assert words.dtype == np.uint32 and words.size == (1 << 16) // 32
+    assert roaring.words_count(words) == vals.size
+    assert np.array_equal(roaring.unpack_words(words), vals.astype(np.int64))
+
+
+def test_pack_range_offset(rng):
+    base = 3 * (1 << 16)
+    vals = random_values(rng, 1000, 1 << 16) + np.uint64(base)
+    b = roaring.Bitmap.from_values(vals)
+    words = roaring.pack_range(b, base, base + (1 << 16))
+    assert np.array_equal(
+        roaring.unpack_words(words) + base, vals.astype(np.int64)
+    )
+    # adjacent empty range packs to zeros
+    assert roaring.words_count(roaring.pack_range(b, 0, 1 << 16)) == 0
+
+
+# ------------------------------------------------------- regression findings
+def test_high_key_range_ops_no_overflow():
+    # values >= 2^63 must work through range_count/range_values/pack_range
+    b = roaring.Bitmap()
+    v = (1 << 63) + 5
+    b.add(v)
+    assert b.range_count(1 << 63, (1 << 63) + 10) == 1
+    assert b.range_values(1 << 63, (1 << 63) + 10).tolist() == [v]
+    words = roaring.pack_range(b, 1 << 63, (1 << 63) + (1 << 16))
+    assert roaring.unpack_words(words).tolist() == [5]
+
+
+def test_container_add_keeps_run_compact():
+    c = ct.from_values(np.arange(100, dtype=np.uint16))
+    assert c.type == ct.TYPE_RUN
+    c2, changed = ct.container_add(c, 200)
+    assert changed and c2.type != ct.TYPE_BITMAP
+    assert ct.container_count(c2) == 101
+
+
+def test_deserialize_truncated_raises_valueerror(rng):
+    data = roaring.serialize(roaring.Bitmap.from_values(random_values(rng, 100, 1 << 20)))
+    for cut in (1, 6, 10, len(data) - 3):
+        with pytest.raises(ValueError):
+            roaring.deserialize(data[:cut])
