@@ -1,0 +1,54 @@
+"""TPU kernel library: the framework's native hot loops.
+
+Replaces the reference's Go roaring CPU loops (roaring/roaring.go) and
+executor aggregation loops (executor.go) with fused XLA programs over dense
+packed words. Everything here is pure-functional and jit/shard_map
+compatible; the executor composes these into per-query programs.
+
+x64 is enabled process-wide: cross-shard Sum/Count reductions carry int64
+on device (TPU emulates 64-bit integer ops; these are tiny scalar/[depth]
+tensors, so the cost is noise next to the popcount scans).
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from pilosa_tpu.ops import bsi, topn
+from pilosa_tpu.ops.bitwise import (
+    column_mask,
+    count_and,
+    count_andnot,
+    count_or,
+    count_xor,
+    matrix_filter_counts,
+    popcount,
+    popcount_rows,
+    popcount_words,
+    shift_words,
+    w_and,
+    w_andnot,
+    w_not,
+    w_or,
+    w_xor,
+)
+
+__all__ = [
+    "bsi",
+    "topn",
+    "column_mask",
+    "count_and",
+    "count_andnot",
+    "count_or",
+    "count_xor",
+    "matrix_filter_counts",
+    "popcount",
+    "popcount_rows",
+    "popcount_words",
+    "shift_words",
+    "w_and",
+    "w_andnot",
+    "w_not",
+    "w_or",
+    "w_xor",
+]
