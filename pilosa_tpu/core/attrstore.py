@@ -1,0 +1,89 @@
+"""Row/column attribute store.
+
+Reference: attrstore.go + boltdb/attrstore.go (AttrStore; attrs synced via
+100-ID block checksums). BoltDB is replaced by a JSON file persisted on
+mutation; the block-checksum diff surface is kept for anti-entropy.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+
+ATTR_BLOCK_SIZE = 100
+
+
+class AttrStore:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._attrs: dict[int, dict] = {}
+
+    def open(self) -> None:
+        with self._lock:
+            if self.path and os.path.exists(self.path):
+                with open(self.path) as f:
+                    raw = json.load(f)
+                self._attrs = {int(k): v for k, v in raw.items()}
+
+    def close(self) -> None:
+        pass
+
+    def _persist(self) -> None:
+        if self.path is None:
+            return
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({str(k): v for k, v in self._attrs.items()}, f)
+        os.replace(tmp, self.path)
+
+    def set_attrs(self, id_: int, attrs: dict) -> None:
+        """Merge attrs for an ID; null values delete keys (reference:
+        AttrStore.SetAttrs)."""
+        with self._lock:
+            current = self._attrs.setdefault(id_, {})
+            for k, v in attrs.items():
+                if v is None:
+                    current.pop(k, None)
+                else:
+                    current[k] = v
+            if not current:
+                self._attrs.pop(id_, None)
+            self._persist()
+
+    def attrs(self, id_: int) -> dict:
+        with self._lock:
+            return dict(self._attrs.get(id_, {}))
+
+    def block_checksums(self) -> list[tuple[int, bytes]]:
+        with self._lock:
+            blocks: dict[int, list[int]] = {}
+            for id_ in self._attrs:
+                blocks.setdefault(id_ // ATTR_BLOCK_SIZE, []).append(id_)
+            out = []
+            for block_id in sorted(blocks):
+                h = hashlib.blake2b(digest_size=16)
+                for id_ in sorted(blocks[block_id]):
+                    h.update(
+                        json.dumps(
+                            [id_, self._attrs[id_]], sort_keys=True
+                        ).encode()
+                    )
+                out.append((block_id, h.digest()))
+            return out
+
+    def block_data(self, block_id: int) -> dict[int, dict]:
+        with self._lock:
+            lo = block_id * ATTR_BLOCK_SIZE
+            hi = lo + ATTR_BLOCK_SIZE
+            return {i: dict(a) for i, a in self._attrs.items() if lo <= i < hi}
+
+    def merge_block(self, data: dict[int, dict]) -> None:
+        with self._lock:
+            for id_, attrs in data.items():
+                current = self._attrs.setdefault(int(id_), {})
+                current.update(attrs)
+            self._persist()

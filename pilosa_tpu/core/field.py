@@ -1,0 +1,330 @@
+"""Field — a typed attribute dimension over columns.
+
+Reference: field.go (Field, FieldOptions, bsiGroup; constants
+bsiExistsBit=0, bsiSignBit=1, bsiOffsetBit=2). Field types:
+
+- ``set``   — multi-value bitmap rows (default)
+- ``mutex`` — single-value: setting a row clears the column's other rows
+- ``bool``  — mutex with exactly rows 0 (false) / 1 (true)
+- ``time``  — set + per-quantum bucket views for time-bounded reads
+- ``int``   — BSI sign-magnitude bit slices in a "bsi" view
+  (row 0 exists, row 1 sign, rows 2.. magnitude LSB-first — the layout
+  ``pilosa_tpu.ops.bsi`` kernels consume directly)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import asdict, dataclass, field as dc_field
+from datetime import datetime
+
+import numpy as np
+
+from pilosa_tpu.core import timequantum
+from pilosa_tpu.core.attrstore import AttrStore
+from pilosa_tpu.core.cache import DEFAULT_CACHE_SIZE
+from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.core.view import VIEW_BSI, VIEW_STANDARD, View
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+FIELD_SET = "set"
+FIELD_MUTEX = "mutex"
+FIELD_BOOL = "bool"
+FIELD_TIME = "time"
+FIELD_INT = "int"
+
+BSI_EXISTS = 0
+BSI_SIGN = 1
+BSI_OFFSET = 2
+
+
+@dataclass
+class FieldOptions:
+    field_type: str = FIELD_SET
+    cache_type: str = "ranked"
+    cache_size: int = DEFAULT_CACHE_SIZE
+    time_quantum: str = ""
+    keys: bool = False
+    min: int = 0
+    max: int = 0
+    no_standard_view: bool = False
+
+    def validate(self) -> None:
+        if self.field_type not in (
+            FIELD_SET,
+            FIELD_MUTEX,
+            FIELD_BOOL,
+            FIELD_TIME,
+            FIELD_INT,
+        ):
+            raise ValueError(f"invalid field type {self.field_type!r}")
+        if self.field_type == FIELD_TIME:
+            timequantum.validate_quantum(self.time_quantum)
+        if self.field_type == FIELD_INT and self.min > self.max:
+            raise ValueError("int field: min > max")
+
+
+class Field:
+    def __init__(self, index: str, name: str, path: str | None, options: FieldOptions):
+        options.validate()
+        self.index = index
+        self.name = name
+        self.path = path  # <index-path>/<field-name>
+        self.options = options
+        self.views: dict[str, View] = {}
+        # row attributes (reference: field.go rowAttrStore) and row-key
+        # translation (reference: translate.go)
+        self.row_attrs = AttrStore(
+            os.path.join(path, ".row_attrs.json") if path else None
+        )
+        self.row_attrs.open()
+        self.row_keys = TranslateStore(
+            os.path.join(path, ".rowkeys.jsonl") if path else None
+        )
+        self.row_keys.open()
+        # BSI magnitude bit depth (grows to fit the widest stored value)
+        self._bit_depth = max(
+            abs(int(options.min)).bit_length(), abs(int(options.max)).bit_length(), 1
+        )
+
+    # -------------------------------------------------------------- meta
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        meta = {"options": asdict(self.options), "bit_depth": self._bit_depth}
+        with open(os.path.join(self.path, ".meta.json"), "w") as f:
+            json.dump(meta, f)
+
+    @classmethod
+    def load(cls, index: str, name: str, path: str) -> "Field":
+        with open(os.path.join(path, ".meta.json")) as f:
+            meta = json.load(f)
+        f_obj = cls(index, name, path, FieldOptions(**meta["options"]))
+        f_obj._bit_depth = meta.get("bit_depth", f_obj._bit_depth)
+        views_dir = os.path.join(path, "views")
+        if os.path.isdir(views_dir):
+            for view_name in sorted(os.listdir(views_dir)):
+                view = f_obj.create_view_if_not_exists(view_name)
+                frags_dir = os.path.join(views_dir, view_name, "fragments")
+                if os.path.isdir(frags_dir):
+                    for shard_name in sorted(os.listdir(frags_dir)):
+                        if shard_name.isdigit() and not shard_name.endswith(".snapshotting"):
+                            view.create_fragment_if_not_exists(int(shard_name))
+        return f_obj
+
+    # ------------------------------------------------------------- views
+    def view(self, name: str) -> View | None:
+        return self.views.get(name)
+
+    def create_view_if_not_exists(self, name: str) -> View:
+        v = self.views.get(name)
+        if v is None:
+            view_path = os.path.join(self.path, "views", name) if self.path else None
+            v = View(
+                name,
+                self.index,
+                self.name,
+                view_path,
+                self.options.cache_type,
+                self.options.cache_size,
+            )
+            self.views[name] = v
+        return v
+
+    def available_shards(self) -> set[int]:
+        shards: set[int] = set()
+        for v in self.views.values():
+            shards |= v.available_shards()
+        return shards
+
+    @property
+    def bit_depth(self) -> int:
+        return self._bit_depth
+
+    def time_bounds(self) -> tuple[datetime, datetime] | None:
+        """[min, max) datetime range covered by materialized time views —
+        bounds open-ended Row(from=/to=) queries to real data instead of
+        enumerating calendar buckets from year 1."""
+        lo: datetime | None = None
+        hi: datetime | None = None
+        for name in self.views:
+            bucket = timequantum.parse_view_bucket(name, VIEW_STANDARD)
+            if bucket is None:
+                continue
+            start, end = bucket
+            lo = start if lo is None or start < lo else lo
+            hi = end if hi is None or end > hi else hi
+        if lo is None or hi is None:
+            return None
+        return lo, hi
+
+    def close(self) -> None:
+        for v in self.views.values():
+            v.close()
+        self.row_attrs.close()
+        self.row_keys.close()
+
+    # --------------------------------------------------------- set paths
+    def _writable_views(self, timestamp: datetime | None) -> list[str]:
+        if self.options.field_type == FIELD_TIME:
+            names = []
+            if not self.options.no_standard_view:
+                names.append(VIEW_STANDARD)
+            if timestamp is not None:
+                names.extend(
+                    timequantum.views_by_time(
+                        VIEW_STANDARD, timestamp, self.options.time_quantum
+                    )
+                )
+            return names
+        return [VIEW_STANDARD]
+
+    def set_bit(self, row: int, col: int, timestamp: datetime | None = None) -> bool:
+        if self.options.field_type == FIELD_INT:
+            raise ValueError("cannot set bits on an int field; use set_value")
+        if self.options.field_type == FIELD_BOOL and row not in (0, 1):
+            raise ValueError("bool field rows must be 0 or 1")
+        shard = col // SHARD_WIDTH
+        changed = False
+        for view_name in self._writable_views(timestamp):
+            frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
+            if self.options.field_type in (FIELD_MUTEX, FIELD_BOOL) and view_name == VIEW_STANDARD:
+                for other in frag.row_ids():
+                    if other != row and frag.contains(other, col):
+                        frag.clear_bit(other, col)
+            changed |= frag.set_bit(row, col)
+        return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        shard = col // SHARD_WIDTH
+        changed = False
+        for view in self.views.values():
+            frag = view.fragment(shard)
+            if frag is not None:
+                changed |= frag.clear_bit(row, col)
+        return changed
+
+    # ---------------------------------------------------------- BSI path
+    def _grow_depth(self, needed: int) -> None:
+        if needed > self._bit_depth:
+            self._bit_depth = needed
+            self.save_meta()
+
+    def set_value(self, col: int, value: int) -> bool:
+        """Store an integer (sign-magnitude BSI write). Overwrites any
+        existing value for the column."""
+        if self.options.field_type != FIELD_INT:
+            raise ValueError(f"field {self.name!r} is not an int field")
+        value = int(value)
+        self._grow_depth(abs(value).bit_length())
+        shard = col // SHARD_WIDTH
+        frag = self.create_view_if_not_exists(VIEW_BSI).create_fragment_if_not_exists(shard)
+        changed = frag.set_bit(BSI_EXISTS, col)
+        if value < 0:
+            changed |= frag.set_bit(BSI_SIGN, col)
+        else:
+            changed |= frag.clear_bit(BSI_SIGN, col)
+        mag = abs(value)
+        for k in range(self._bit_depth):
+            if (mag >> k) & 1:
+                changed |= frag.set_bit(BSI_OFFSET + k, col)
+            else:
+                changed |= frag.clear_bit(BSI_OFFSET + k, col)
+        return changed
+
+    def value(self, col: int) -> tuple[int, bool]:
+        """(value, exists) for a column."""
+        if self.options.field_type != FIELD_INT:
+            raise ValueError(f"field {self.name!r} is not an int field")
+        view = self.view(VIEW_BSI)
+        frag = view.fragment(col // SHARD_WIDTH) if view else None
+        if frag is None or not frag.contains(BSI_EXISTS, col):
+            return 0, False
+        mag = 0
+        for k in range(self._bit_depth):
+            if frag.contains(BSI_OFFSET + k, col):
+                mag |= 1 << k
+        return (-mag if frag.contains(BSI_SIGN, col) else mag), True
+
+    def clear_value(self, col: int) -> bool:
+        view = self.view(VIEW_BSI)
+        frag = view.fragment(col // SHARD_WIDTH) if view else None
+        if frag is None:
+            return False
+        changed = frag.clear_bit(BSI_EXISTS, col)
+        frag.clear_bit(BSI_SIGN, col)
+        for k in range(self._bit_depth):
+            frag.clear_bit(BSI_OFFSET + k, col)
+        return changed
+
+    # ------------------------------------------------------ bulk imports
+    def import_bulk(
+        self,
+        rows: np.ndarray,
+        cols: np.ndarray,
+        timestamps: list[datetime | None] | None = None,
+        clear: bool = False,
+    ) -> None:
+        """Batched bit import grouped by shard (reference: field.Import →
+        fragment.bulkImport). ``timestamps`` routes time-field writes into
+        bucket views as well."""
+        rows = np.asarray(rows, dtype=np.uint64)
+        cols = np.asarray(cols, dtype=np.uint64)
+        if self.options.field_type in (FIELD_MUTEX, FIELD_BOOL):
+            # mutex semantics are per-bit; route through set_bit
+            for i in range(rows.size):
+                if clear:
+                    self.clear_bit(int(rows[i]), int(cols[i]))
+                else:
+                    ts = timestamps[i] if timestamps else None
+                    self.set_bit(int(rows[i]), int(cols[i]), ts)
+            return
+        shards = cols // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards).tolist():
+            m = shards == shard
+            if timestamps is None or self.options.field_type != FIELD_TIME:
+                views = self._writable_views(None)
+                for view_name in views:
+                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(int(shard))
+                    frag.bulk_import(rows[m], cols[m], clear=clear)
+            else:
+                idx = np.flatnonzero(m)
+                by_view: dict[str, list[int]] = {}
+                for i in idx.tolist():
+                    for view_name in self._writable_views(timestamps[i]):
+                        by_view.setdefault(view_name, []).append(i)
+                for view_name, ids in by_view.items():
+                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(int(shard))
+                    frag.bulk_import(rows[ids], cols[ids], clear=clear)
+
+    def import_values(self, cols: np.ndarray, values: np.ndarray) -> None:
+        """Batched BSI import (reference: field.importValue). Vectorized
+        per bit-slice: one add_many/remove_many pair per slice per shard
+        (overwrite semantics — old magnitude bits are cleared)."""
+        if self.options.field_type != FIELD_INT:
+            raise ValueError(f"field {self.name!r} is not an int field")
+        cols = np.asarray(cols, dtype=np.uint64)
+        values = np.asarray(values, dtype=np.int64)
+        if cols.size == 0:
+            return
+        self._grow_depth(int(np.abs(values).max()).bit_length())
+        shards = cols // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards).tolist():
+            m = shards == shard
+            c, v = cols[m], values[m]
+            frag = self.create_view_if_not_exists(VIEW_BSI).create_fragment_if_not_exists(int(shard))
+            zeros = np.zeros(c.size, dtype=np.uint64)
+            frag.bulk_import(zeros + BSI_EXISTS, c)
+            neg = v < 0
+            frag.bulk_import(zeros[neg] + BSI_SIGN, c[neg])
+            frag.bulk_import(zeros[~neg] + BSI_SIGN, c[~neg], clear=True)
+            mags = np.abs(v).astype(np.uint64)
+            for k in range(self._bit_depth):
+                bit = ((mags >> np.uint64(k)) & np.uint64(1)) == 1
+                row = np.uint64(BSI_OFFSET + k)
+                if bit.any():
+                    frag.bulk_import(zeros[bit] + row, c[bit])
+                if (~bit).any():
+                    frag.bulk_import(zeros[~bit] + row, c[~bit], clear=True)

@@ -1,0 +1,343 @@
+"""Fragment — the storage/compute unit: one (index, field, view, shard).
+
+Reference: fragment.go (fragment, setBit/clearBit, row, rows, top,
+importRoaring, bulkImport, snapshot, blocks/blockData/checksum). Redesigned
+for TPU execution:
+
+- the authoritative store is a host roaring Bitmap (bit position =
+  row * SHARD_WIDTH + column-in-shard, identical to the reference) with the
+  snapshot + append-only-ops-log durability discipline;
+- the *compute* representation is a dense packed bit matrix
+  ``uint32[padded_rows, WORDS_PER_SHARD]`` cached on device. Mutations mark
+  rows dirty; the next query repacks dirty rows host-side and re-uploads.
+  Row capacity grows by doubling so device shapes stay stable and XLA
+  recompiles are rare (SURVEY.md §7 hard part (d)).
+
+Unlike the reference there is no per-fragment RWMutex — the executor runs
+queries against immutable device arrays, and host mutation is serialized by
+a per-fragment lock only around bitmap/ops-log updates.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+
+import numpy as np
+
+from pilosa_tpu import roaring
+from pilosa_tpu.core.cache import NopCache, make_cache
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+MAX_OP_N = 2000  # ops-log length that triggers a snapshot (reference default)
+ROWS_PER_BLOCK = 100  # anti-entropy block granularity (reference: HashBlockSize)
+MIN_PADDED_ROWS = 8  # sublane tile for int32
+
+
+def _pad_rows(n: int) -> int:
+    p = MIN_PADDED_ROWS
+    while p < n:
+        p *= 2
+    return p
+
+
+class Fragment:
+    def __init__(
+        self,
+        path: str | None,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        cache_type: str = "ranked",
+        cache_size: int = 50_000,
+    ):
+        self.path = path
+        self.index = index
+        self.field = field
+        self.view = view
+        self.shard = shard
+        self.bitmap = roaring.Bitmap()
+        self.cache = make_cache(cache_type, cache_size)
+        self.op_n = 0
+        self.max_op_n = MAX_OP_N
+        self._lock = threading.RLock()
+        self._file = None
+
+        self._np_matrix: np.ndarray | None = None
+        self._dirty_rows: set[int] = set()
+        self._all_dirty = True
+        self._device = None
+
+    # ----------------------------------------------------------- lifecycle
+    def open(self) -> None:
+        """Load snapshot + replay ops log (reference: fragment.Open)."""
+        with self._lock:
+            if self.path and os.path.exists(self.path):
+                with open(self.path, "rb") as f:
+                    data = f.read()
+                if data:
+                    self.bitmap, consumed = roaring.deserialize(data)
+                    self.op_n = roaring.replay_ops(self.bitmap, data[consumed:])
+            if self.path:
+                os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                if not os.path.exists(self.path):
+                    self._write_snapshot()
+                self._file = open(self.path, "ab")
+            self._rebuild_cache()
+            self._all_dirty = True
+            self._device = None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+    def _append_op(self, opcode: int, values: np.ndarray) -> None:
+        if self._file is None:
+            return
+        self._file.write(roaring.append_op(opcode, values))
+        self._file.flush()
+        self.op_n += 1
+        if self.op_n > self.max_op_n:
+            self.snapshot()
+
+    def snapshot(self) -> None:
+        """Durable full rewrite; truncates the ops log (reference:
+        fragment.snapshot)."""
+        with self._lock:
+            if self.path is None:
+                self.op_n = 0
+                return
+            if self._file:
+                self._file.close()
+            self._write_snapshot()
+            self._file = open(self.path, "ab")
+            self.op_n = 0
+
+    def _write_snapshot(self) -> None:
+        tmp = self.path + ".snapshotting"
+        with open(tmp, "wb") as f:
+            f.write(roaring.serialize(self.bitmap))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self.path)
+
+    # ------------------------------------------------------------- rows
+    def n_rows(self) -> int:
+        if not self.bitmap._containers:
+            return 0
+        return self.bitmap.max() // SHARD_WIDTH + 1
+
+    def row_ids(self) -> list[int]:
+        """Row IDs with ≥1 bit set. Derived from container keys (each key
+        covers 2^16 positions) — no full scan (reference: fragment.rows)."""
+        keys = np.fromiter(self.bitmap._containers.keys(), dtype=np.int64)
+        if keys.size == 0:
+            return []
+        # each container key covers positions [key<<16, (key+1)<<16); that
+        # span may overlap several rows when SHARD_WIDTH < 2^16
+        candidates: set[int] = set()
+        for key in keys.tolist():
+            first = (key << 16) // SHARD_WIDTH
+            last = ((key + 1) << 16) - 1
+            candidates.update(range(first, last // SHARD_WIDTH + 1))
+        return [
+            r
+            for r in sorted(candidates)
+            if self.bitmap.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
+        ]
+
+    def row_columns(self, row: int) -> np.ndarray:
+        """Absolute column IDs set in a row, ascending (uint64)."""
+        start = row * SHARD_WIDTH
+        rel = self.bitmap.range_values(start, start + SHARD_WIDTH) - np.uint64(start)
+        return rel + np.uint64(self.shard * SHARD_WIDTH)
+
+    def row_packed(self, row: int) -> np.ndarray:
+        start = row * SHARD_WIDTH
+        return roaring.pack_range(self.bitmap, start, start + SHARD_WIDTH)
+
+    def row_count(self, row: int) -> int:
+        start = row * SHARD_WIDTH
+        return self.bitmap.range_count(start, start + SHARD_WIDTH)
+
+    # --------------------------------------------------------- mutation
+    def _pos(self, row: int, col: int) -> int:
+        return row * SHARD_WIDTH + (col % SHARD_WIDTH)
+
+    def set_bit(self, row: int, col: int) -> bool:
+        with self._lock:
+            pos = self._pos(row, col)
+            changed = self.bitmap.add(pos)
+            if changed:
+                self._append_op(roaring.OP_ADD, np.array([pos], dtype=np.uint64))
+                self._mark_dirty(row)
+                self.cache.add(row, self.row_count(row))
+            return changed
+
+    def clear_bit(self, row: int, col: int) -> bool:
+        with self._lock:
+            pos = self._pos(row, col)
+            changed = self.bitmap.remove(pos)
+            if changed:
+                self._append_op(roaring.OP_REMOVE, np.array([pos], dtype=np.uint64))
+                self._mark_dirty(row)
+                self.cache.add(row, self.row_count(row))
+            return changed
+
+    def contains(self, row: int, col: int) -> bool:
+        return self.bitmap.contains(self._pos(row, col))
+
+    def clear_row(self, row: int) -> bool:
+        """Remove every bit in a row (PQL ClearRow)."""
+        with self._lock:
+            start = row * SHARD_WIDTH
+            positions = self.bitmap.range_values(start, start + SHARD_WIDTH)
+            if positions.size == 0:
+                return False
+            self.bitmap.remove_many(positions)
+            self._append_op(roaring.OP_REMOVE, positions)
+            self._mark_dirty(row)
+            self.cache.add(row, 0)
+            return True
+
+    def set_row(self, row: int, columns: np.ndarray) -> bool:
+        """Replace a row's contents with ``columns`` (in-shard positions;
+        PQL Store)."""
+        with self._lock:
+            self.clear_row(row)
+            if columns.size:
+                positions = (
+                    np.asarray(columns, dtype=np.uint64) % SHARD_WIDTH
+                ) + np.uint64(row * SHARD_WIDTH)
+                self.bitmap.add_many(positions)
+                self._append_op(roaring.OP_ADD, positions)
+            self._mark_dirty(row)
+            self.cache.add(row, self.row_count(row))
+            return True
+
+    def bulk_import(self, rows: np.ndarray, cols: np.ndarray, clear: bool = False) -> None:
+        """Batched set/clear (reference: fragment.bulkImport). ``cols`` are
+        absolute or in-shard column IDs; reduced mod SHARD_WIDTH."""
+        with self._lock:
+            rows = np.asarray(rows, dtype=np.uint64)
+            cols = np.asarray(cols, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
+            positions = rows * np.uint64(SHARD_WIDTH) + cols
+            if clear:
+                self.bitmap.remove_many(positions)
+                self._append_op(roaring.OP_REMOVE, positions)
+            else:
+                self.bitmap.add_many(positions)
+                self._append_op(roaring.OP_ADD, positions)
+            for r in np.unique(rows).tolist():
+                self._mark_dirty(int(r))
+                self.cache.add(int(r), self.row_count(int(r)))
+
+    def import_roaring(self, data: bytes) -> None:
+        """Union a serialized roaring bitmap of fragment-relative positions
+        straight into storage (reference: fragment.importRoaring fast path);
+        snapshots rather than logging the (potentially huge) delta."""
+        with self._lock:
+            incoming, consumed = roaring.deserialize(data)
+            roaring.replay_ops(incoming, data[consumed:])
+            self.bitmap = self.bitmap | incoming
+            self.snapshot()
+            self._all_dirty = True
+            self._device = None
+            self._rebuild_cache()
+
+    def _mark_dirty(self, row: int) -> None:
+        self._dirty_rows.add(row)
+        self._device = None
+
+    def _rebuild_cache(self) -> None:
+        self.cache.clear()
+        if isinstance(self.cache, NopCache):
+            return
+        for r in self.row_ids():
+            self.cache.add(r, self.row_count(r))
+
+    # ----------------------------------------------------------- device
+    def device_matrix(self):
+        """(jax uint32[R_pad, W], n_rows) — packed matrix on device.
+
+        Dirty rows are repacked host-side incrementally; the device upload
+        happens only when something changed since the last query.
+        """
+        import jax.numpy as jnp  # deferred: keep host paths importable fast
+
+        with self._lock:
+            n = max(self.n_rows(), 1)
+            r_pad = _pad_rows(n)
+            if (
+                self._np_matrix is None
+                or self._all_dirty
+                or self._np_matrix.shape[0] < n
+            ):
+                m = np.zeros((r_pad, WORDS_PER_SHARD), dtype=np.uint32)
+                for r in self.row_ids():
+                    m[r] = self.row_packed(r)
+                self._np_matrix = m
+                self._all_dirty = False
+                self._dirty_rows.clear()
+                self._device = None
+            elif self._dirty_rows:
+                for r in self._dirty_rows:
+                    if r < self._np_matrix.shape[0]:
+                        self._np_matrix[r] = self.row_packed(r)
+                self._dirty_rows.clear()
+                self._device = None
+            if self._device is None:
+                self._device = jnp.asarray(self._np_matrix)
+            return self._device, n
+
+    # ------------------------------------------------------ anti-entropy
+    def block_checksums(self) -> list[tuple[int, bytes]]:
+        """[(block_id, checksum)] over 100-row blocks with any bits set
+        (reference: fragment.blocks). Used by the holder syncer to diff
+        replicas cheaply."""
+        out = []
+        rows = self.row_ids()
+        if not rows:
+            return out
+        blocks: dict[int, list[int]] = {}
+        for r in rows:
+            blocks.setdefault(r // ROWS_PER_BLOCK, []).append(r)
+        for block_id in sorted(blocks):
+            h = hashlib.blake2b(digest_size=16)
+            start = block_id * ROWS_PER_BLOCK * SHARD_WIDTH
+            stop = (block_id + 1) * ROWS_PER_BLOCK * SHARD_WIDTH
+            h.update(self.bitmap.range_values(start, stop).tobytes())
+            out.append((block_id, h.digest()))
+        return out
+
+    def block_data(self, block_id: int) -> tuple[np.ndarray, np.ndarray]:
+        """(row_ids, in-shard columns) for one block (reference:
+        fragment.blockData)."""
+        start = block_id * ROWS_PER_BLOCK * SHARD_WIDTH
+        stop = (block_id + 1) * ROWS_PER_BLOCK * SHARD_WIDTH
+        positions = self.bitmap.range_values(start, stop)
+        rows = positions // np.uint64(SHARD_WIDTH)
+        cols = positions % np.uint64(SHARD_WIDTH)
+        return rows, cols
+
+    def merge_block(self, block_id: int, rows: np.ndarray, cols: np.ndarray) -> None:
+        """Replace one block's contents with the reconciled (rows, cols)
+        (anti-entropy repair: reference holder_syncer block merge)."""
+        with self._lock:
+            start = block_id * ROWS_PER_BLOCK * SHARD_WIDTH
+            stop = (block_id + 1) * ROWS_PER_BLOCK * SHARD_WIDTH
+            existing = self.bitmap.range_values(start, stop)
+            incoming = (
+                np.asarray(rows, dtype=np.uint64) * np.uint64(SHARD_WIDTH)
+                + np.asarray(cols, dtype=np.uint64)
+            )
+            self.bitmap.remove_many(existing)
+            self.bitmap.add_many(incoming)
+            self.snapshot()
+            self._all_dirty = True
+            self._device = None
+            self._rebuild_cache()

@@ -1,0 +1,103 @@
+"""Holder — the node-local root of all data.
+
+Reference: holder.go (Holder, Open — walks the data dir loading every
+index/field/view/fragment). Directory layout:
+
+    <data-dir>/<index>/.meta.json
+    <data-dir>/<index>/<field>/.meta.json
+    <data-dir>/<index>/<field>/views/<view>/fragments/<shard>
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+
+from pilosa_tpu.core.index import Index, IndexOptions
+
+
+class Holder:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self.indexes: dict[str, Index] = {}
+
+    def open(self) -> None:
+        if self.path is None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        for entry in sorted(os.listdir(self.path)):
+            index_path = os.path.join(self.path, entry)
+            if os.path.isdir(index_path) and os.path.exists(
+                os.path.join(index_path, ".meta.json")
+            ):
+                self.indexes[entry] = Index.load(entry, index_path)
+
+    def close(self) -> None:
+        for idx in self.indexes.values():
+            idx.close()
+
+    def index(self, name: str) -> Index | None:
+        return self.indexes.get(name)
+
+    def create_index(self, name: str, options: IndexOptions | None = None) -> Index:
+        if name in self.indexes:
+            raise ValueError(f"index {name!r} already exists")
+        return self.create_index_if_not_exists(name, options)
+
+    def create_index_if_not_exists(
+        self, name: str, options: IndexOptions | None = None
+    ) -> Index:
+        existing = self.indexes.get(name)
+        if existing is not None:
+            return existing
+        index_path = os.path.join(self.path, name) if self.path else None
+        idx = Index(name, index_path, options)
+        idx.save_meta()
+        self.indexes[name] = idx
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        idx = self.indexes.pop(name, None)
+        if idx is None:
+            raise KeyError(f"index {name!r} not found")
+        idx.close()
+        if idx.path and os.path.isdir(idx.path):
+            shutil.rmtree(idx.path)
+
+    def schema(self) -> list[dict]:
+        """Schema description (reference: api.Schema)."""
+        out = []
+        for iname in sorted(self.indexes):
+            idx = self.indexes[iname]
+            fields = []
+            for fname in sorted(idx.fields):
+                f = idx.fields[fname]
+                if fname.startswith("_"):
+                    continue
+                fields.append(
+                    {
+                        "name": fname,
+                        "options": {
+                            "type": f.options.field_type,
+                            "cacheType": f.options.cache_type,
+                            "cacheSize": f.options.cache_size,
+                            "timeQuantum": f.options.time_quantum,
+                            "keys": f.options.keys,
+                            "min": f.options.min,
+                            "max": f.options.max,
+                        },
+                        "shards": sorted(f.available_shards()),
+                    }
+                )
+            out.append(
+                {
+                    "name": iname,
+                    "options": {
+                        "keys": idx.options.keys,
+                        "trackExistence": idx.options.track_existence,
+                    },
+                    "fields": fields,
+                    "shards": sorted(idx.available_shards()),
+                }
+            )
+        return out

@@ -1,0 +1,121 @@
+"""Index — a namespace of fields over one column universe.
+
+Reference: index.go (Index, CreateField, DeleteField; options keys /
+trackExistence). When ``track_existence`` is on, every column write also
+sets row 0 of the internal ``_exists`` field, which backs Not() and All().
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+from pilosa_tpu.core.attrstore import AttrStore
+from pilosa_tpu.core.field import FIELD_SET, Field, FieldOptions
+from pilosa_tpu.core.translate import TranslateStore
+
+EXISTENCE_FIELD = "_exists"
+
+
+@dataclass
+class IndexOptions:
+    keys: bool = False
+    track_existence: bool = True
+
+
+class Index:
+    def __init__(self, name: str, path: str | None, options: IndexOptions | None = None):
+        self.name = name
+        self.path = path  # <holder-path>/<index-name>
+        self.options = options or IndexOptions()
+        self.fields: dict[str, Field] = {}
+        # column attributes (reference: index.go columnAttrStore) and
+        # column-key translation (reference: translate.go)
+        self.column_attrs = AttrStore(
+            os.path.join(path, ".column_attrs.json") if path else None
+        )
+        self.column_attrs.open()
+        self.column_keys = TranslateStore(
+            os.path.join(path, ".keys.jsonl") if path else None
+        )
+        self.column_keys.open()
+
+    # -------------------------------------------------------------- meta
+    def save_meta(self) -> None:
+        if self.path is None:
+            return
+        os.makedirs(self.path, exist_ok=True)
+        with open(os.path.join(self.path, ".meta.json"), "w") as f:
+            json.dump({"options": asdict(self.options)}, f)
+
+    @classmethod
+    def load(cls, name: str, path: str) -> "Index":
+        with open(os.path.join(path, ".meta.json")) as f:
+            meta = json.load(f)
+        idx = cls(name, path, IndexOptions(**meta["options"]))
+        for entry in sorted(os.listdir(path)):
+            field_path = os.path.join(path, entry)
+            if os.path.isdir(field_path) and os.path.exists(
+                os.path.join(field_path, ".meta.json")
+            ):
+                idx.fields[entry] = Field.load(name, entry, field_path)
+        return idx
+
+    # ------------------------------------------------------------ fields
+    def field(self, name: str) -> Field | None:
+        return self.fields.get(name)
+
+    def create_field(self, name: str, options: FieldOptions | None = None) -> Field:
+        if name in self.fields:
+            raise ValueError(f"field {name!r} already exists")
+        return self.create_field_if_not_exists(name, options)
+
+    def create_field_if_not_exists(
+        self, name: str, options: FieldOptions | None = None
+    ) -> Field:
+        existing = self.fields.get(name)
+        if existing is not None:
+            return existing
+        field_path = os.path.join(self.path, name) if self.path else None
+        f = Field(self.name, name, field_path, options or FieldOptions())
+        f.save_meta()
+        self.fields[name] = f
+        return f
+
+    def delete_field(self, name: str) -> None:
+        f = self.fields.pop(name, None)
+        if f is None:
+            raise KeyError(f"field {name!r} not found")
+        f.close()
+        if f.path and os.path.isdir(f.path):
+            shutil.rmtree(f.path)
+
+    # --------------------------------------------------------- existence
+    def existence_field(self) -> Field | None:
+        if not self.options.track_existence:
+            return None
+        return self.create_field_if_not_exists(
+            EXISTENCE_FIELD, FieldOptions(field_type=FIELD_SET, cache_type="none")
+        )
+
+    def mark_columns_exist(self, cols: np.ndarray) -> None:
+        ef = self.existence_field()
+        if ef is not None and np.asarray(cols).size:
+            cols = np.asarray(cols, dtype=np.uint64)
+            ef.import_bulk(np.zeros(cols.size, dtype=np.uint64), cols)
+
+    def available_shards(self) -> set[int]:
+        shards: set[int] = set()
+        for f in self.fields.values():
+            shards |= f.available_shards()
+        return shards
+
+    def close(self) -> None:
+        for f in self.fields.values():
+            f.close()
+        self.column_attrs.close()
+        self.column_keys.close()

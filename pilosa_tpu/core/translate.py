@@ -1,0 +1,101 @@
+"""String key ↔ uint64 ID translation.
+
+Reference: translate.go (TranslateStore, TranslateFile — an append-only
+mmap log; primary writes, replicas tail). Here: an in-memory dict pair with
+an append-only JSON-lines log for durability and replication tailing (the
+log offset is the replication cursor — see the cluster layer).
+
+One store instance serves either an index's column keys or one field's row
+keys (reference keeps per-index and per-field maps in one file; separate
+files are simpler and shard-friendly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+
+class TranslateStore:
+    def __init__(self, path: str | None = None):
+        self.path = path
+        self._lock = threading.RLock()
+        self._by_key: dict[str, int] = {}
+        self._by_id: dict[int, str] = {}
+        self._next_id = 1  # 0 is reserved (reference never allocates 0)
+        self._file = None
+
+    def open(self) -> None:
+        with self._lock:
+            if self.path is None:
+                return
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            if os.path.exists(self.path):
+                with open(self.path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            entry = json.loads(line)
+                        except json.JSONDecodeError:
+                            break  # torn tail write
+                        self._apply(entry["k"], entry["id"])
+            self._file = open(self.path, "a")
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
+
+    def _apply(self, key: str, id_: int) -> None:
+        self._by_key[key] = id_
+        self._by_id[id_] = key
+        self._next_id = max(self._next_id, id_ + 1)
+
+    def translate_key(self, key: str, create: bool = True) -> int | None:
+        """key → ID, allocating when ``create`` (reference:
+        TranslateStore.TranslateColumnsToUint64)."""
+        with self._lock:
+            id_ = self._by_key.get(key)
+            if id_ is not None:
+                return id_
+            if not create:
+                return None
+            id_ = self._next_id
+            self._apply(key, id_)
+            if self._file:
+                self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
+                self._file.flush()
+            return id_
+
+    def translate_keys(self, keys: list[str], create: bool = True) -> list[int | None]:
+        return [self.translate_key(k, create) for k in keys]
+
+    def translate_id(self, id_: int) -> str | None:
+        with self._lock:
+            return self._by_id.get(id_)
+
+    def translate_ids(self, ids: list[int]) -> list[str | None]:
+        with self._lock:
+            return [self._by_id.get(i) for i in ids]
+
+    # ------------------------------------------------- replication support
+    def entries_from(self, offset: int) -> tuple[list[tuple[str, int]], int]:
+        """All (key, id) pairs after a cursor for replica tailing
+        (reference: /internal/translate/data streaming)."""
+        with self._lock:
+            items = sorted(self._by_id.items())
+            tail = [(k, i) for i, k in items if i > offset]
+            return [(k, i) for (k, i) in tail], (items[-1][0] if items else 0)
+
+    def apply_entries(self, entries: list[tuple[str, int]]) -> None:
+        with self._lock:
+            for key, id_ in entries:
+                self._apply(key, id_)
+                if self._file:
+                    self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
+            if self._file:
+                self._file.flush()

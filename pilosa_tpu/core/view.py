@@ -1,0 +1,63 @@
+"""View — groups the fragments of one variant of a field.
+
+Reference: view.go (view, viewStandard, time-view naming). A set field has
+one "standard" view; a time field adds one view per calendar bucket; an int
+(BSI) field keeps its bit-slice rows in a "bsi" view.
+"""
+
+from __future__ import annotations
+
+import os
+
+from pilosa_tpu.core.fragment import Fragment
+
+VIEW_STANDARD = "standard"
+VIEW_BSI = "bsi"
+
+
+class View:
+    def __init__(
+        self,
+        name: str,
+        index: str,
+        field: str,
+        path: str | None,
+        cache_type: str,
+        cache_size: int,
+    ):
+        self.name = name
+        self.index = index
+        self.field = field
+        self.path = path  # <field-path>/views/<name>
+        self.cache_type = cache_type
+        self.cache_size = cache_size
+        self.fragments: dict[int, Fragment] = {}
+
+    def fragment(self, shard: int) -> Fragment | None:
+        return self.fragments.get(shard)
+
+    def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        frag = self.fragments.get(shard)
+        if frag is None:
+            frag_path = (
+                os.path.join(self.path, "fragments", str(shard)) if self.path else None
+            )
+            frag = Fragment(
+                frag_path,
+                self.index,
+                self.field,
+                self.name,
+                shard,
+                cache_type=self.cache_type,
+                cache_size=self.cache_size,
+            )
+            frag.open()
+            self.fragments[shard] = frag
+        return frag
+
+    def available_shards(self) -> set[int]:
+        return set(self.fragments)
+
+    def close(self) -> None:
+        for frag in self.fragments.values():
+            frag.close()

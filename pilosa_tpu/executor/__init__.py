@@ -1,0 +1,6 @@
+"""L2 query execution (reference: executor.go, row.go)."""
+
+from pilosa_tpu.executor.executor import ExecutionError, Executor, SumCount
+from pilosa_tpu.executor.row import RowResult
+
+__all__ = ["Executor", "ExecutionError", "RowResult", "SumCount"]

@@ -94,6 +94,8 @@ def shift_words(words, n: int):
     Implemented as a word roll + cross-word carry. ``n`` is static so XLA
     sees fixed shift amounts.
     """
+    if n < 0:
+        raise ValueError(f"shift amount must be non-negative, got {n}")
     if n == 0:
         return words
     q, r = n // BITS_PER_WORD, n % BITS_PER_WORD

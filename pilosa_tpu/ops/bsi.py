@@ -64,7 +64,15 @@ def compare(slices, op: str, value: int):
     pos = exists & ~sign
     neg = exists & sign
     c_abs = abs(value)
-    eq_m, lt_m, gt_m = _magnitude_cmp(mag, c_abs)
+    if c_abs >= 1 << mag.shape[0]:
+        # |c| exceeds every representable magnitude: nothing equal/greater,
+        # every stored magnitude is smaller
+        w = mag.shape[1]
+        eq_m = jnp.zeros((w,), jnp.uint32)
+        gt_m = jnp.zeros((w,), jnp.uint32)
+        lt_m = jnp.full((w,), _ONES)
+    else:
+        eq_m, lt_m, gt_m = _magnitude_cmp(mag, c_abs)
 
     if value >= 0:
         eq = pos & eq_m

@@ -1,0 +1,6 @@
+"""L2 query language: PQL parsing (reference: pql/ package)."""
+
+from pilosa_tpu.pql.ast import Call, Condition
+from pilosa_tpu.pql.parser import PQLError, parse
+
+__all__ = ["Call", "Condition", "parse", "PQLError"]

@@ -11,7 +11,7 @@ after the snapshot which are replayed on load.
 Layout (little-endian):
     header:   uint16 magic=12348 | uint16 version=0 | uint32 n_containers
     metadata: n × (uint64 key | uint16 type | uint16 pad | uint32 cardinality)
-    offsets:  n × uint32 (byte offset of payload from file start)
+    offsets:  n × uint64 (byte offset of payload from file start)
     payloads: array: n×uint16; bitmap: 1024×uint64; run: n_runs×(2×uint16),
               run payload prefixed by uint32 n_runs
     ops log:  repeated (uint8 magic=0xF1 | uint8 opcode | uint32 count |
@@ -55,9 +55,9 @@ def serialize(bitmap: Bitmap) -> bytes:
             payload = struct.pack("<I", c.data.shape[0]) + c.data.tobytes()
         payloads.append(payload)
         buf.write(_META.pack(key, c.type, 0, ct.container_count(c)))
-    offset = _HEADER.size + len(keys) * (_META.size + 4)
+    offset = _HEADER.size + len(keys) * (_META.size + 8)
     for payload in payloads:
-        buf.write(struct.pack("<I", offset))
+        buf.write(struct.pack("<Q", offset))
         offset += len(payload)
     for payload in payloads:
         buf.write(payload)
@@ -90,9 +90,9 @@ def _deserialize(data: bytes) -> tuple[Bitmap, int]:
         metas.append((key, ctype, card))
     off_base = meta_off + n * _META.size
     offsets = [
-        struct.unpack_from("<I", data, off_base + 4 * i)[0] for i in range(n)
+        struct.unpack_from("<Q", data, off_base + 8 * i)[0] for i in range(n)
     ]
-    end = _HEADER.size + n * (_META.size + 4)
+    end = _HEADER.size + n * (_META.size + 8)
     for (key, ctype, card), off in zip(metas, offsets):
         if ctype == ct.TYPE_ARRAY:
             size = card * 2

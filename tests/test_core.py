@@ -1,0 +1,280 @@
+"""L1 data-model tests: fragment, field types, views, holder reload.
+
+Mirrors the reference's fragment_internal_test.go / field_test.go /
+holder_test.go coverage areas."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import core, roaring
+from pilosa_tpu.core.timequantum import views_by_time, views_by_time_range
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+
+# ------------------------------------------------------------------ fragment
+def test_fragment_set_clear_row(tmp_path):
+    frag = core.Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    frag.open()
+    assert frag.set_bit(3, 100)
+    assert not frag.set_bit(3, 100)
+    assert frag.set_bit(3, 200)
+    assert frag.set_bit(7, 100)
+    assert frag.contains(3, 100)
+    assert frag.row_count(3) == 2
+    assert frag.row_ids() == [3, 7]
+    assert np.array_equal(frag.row_columns(3), np.array([100, 200], dtype=np.uint64))
+    assert frag.clear_bit(3, 200)
+    assert frag.row_count(3) == 1
+    frag.close()
+
+
+def test_fragment_persistence_and_oplog_replay(tmp_path):
+    path = str(tmp_path / "frag")
+    frag = core.Fragment(path, "i", "f", "standard", 2)
+    frag.open()
+    rows = np.array([0, 0, 1, 5], dtype=np.uint64)
+    cols = np.array([1, 9, 9, 1000], dtype=np.uint64)
+    frag.bulk_import(rows, cols)
+    frag.set_bit(1, 50)
+    frag.close()
+
+    frag2 = core.Fragment(path, "i", "f", "standard", 2)
+    frag2.open()
+    assert frag2.contains(0, 1) and frag2.contains(0, 9)
+    assert frag2.contains(1, 9) and frag2.contains(1, 50)
+    assert frag2.contains(5, 1000)
+    assert frag2.cache.get(0) == 2
+    frag2.close()
+
+
+def test_fragment_snapshot_truncates_oplog(tmp_path):
+    path = str(tmp_path / "frag")
+    frag = core.Fragment(path, "i", "f", "standard", 0)
+    frag.open()
+    frag.max_op_n = 5
+    for i in range(10):
+        frag.set_bit(0, i)
+    assert frag.op_n <= 5  # snapshot fired at least once
+    frag.close()
+    frag2 = core.Fragment(path, "i", "f", "standard", 0)
+    frag2.open()
+    assert frag2.row_count(0) == 10
+    frag2.close()
+
+
+def test_fragment_device_matrix_dirty_tracking(tmp_path):
+    frag = core.Fragment(None, "i", "f", "standard", 0)
+    frag.open()
+    frag.set_bit(0, 10)
+    frag.set_bit(2, 20)
+    m, n = frag.device_matrix()
+    assert n == 3 and m.shape[1] == WORDS_PER_SHARD
+    assert m.shape[0] >= n
+    m_np = np.asarray(m)
+    assert m_np[0, 0] == 1 << 10
+    assert m_np[2, 0] == 1 << 20
+    first = m
+    m2, _ = frag.device_matrix()
+    assert m2 is first  # cached, no re-upload
+    frag.set_bit(0, 11)
+    m3, _ = frag.device_matrix()
+    assert m3 is not first
+    assert np.asarray(m3)[0, 0] == (1 << 10) | (1 << 11)
+
+
+def test_fragment_import_roaring(tmp_path):
+    frag = core.Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    frag.open()
+    frag.set_bit(0, 5)
+    incoming = roaring.Bitmap.from_values(
+        np.array([3, SHARD_WIDTH * 2 + 7], dtype=np.uint64)
+    )
+    frag.import_roaring(roaring.serialize(incoming))
+    assert frag.contains(0, 5) and frag.contains(0, 3) and frag.contains(2, 7)
+    frag.close()
+
+
+def test_fragment_blocks_checksum_diff(tmp_path):
+    a = core.Fragment(None, "i", "f", "standard", 0)
+    b = core.Fragment(None, "i", "f", "standard", 0)
+    a.open(), b.open()
+    for frag in (a, b):
+        frag.set_bit(0, 1)
+        frag.set_bit(250, 3)
+    assert a.block_checksums() == b.block_checksums()
+    b.set_bit(250, 4)
+    ca, cb = dict(a.block_checksums()), dict(b.block_checksums())
+    assert ca[0] == cb[0] and ca[2] != cb[2]
+    rows, cols = b.block_data(2)
+    a.merge_block(2, rows, cols)
+    assert a.block_checksums() == b.block_checksums()
+
+
+# ------------------------------------------------------------------- field
+def test_mutex_field_single_value():
+    f = core.Field("i", "f", None, core.FieldOptions(field_type=core.FIELD_MUTEX))
+    f.set_bit(1, 42)
+    f.set_bit(2, 42)
+    frag = f.view(core.VIEW_STANDARD).fragment(0)
+    assert not frag.contains(1, 42)
+    assert frag.contains(2, 42)
+
+
+def test_bool_field_validation():
+    f = core.Field("i", "f", None, core.FieldOptions(field_type=core.FIELD_BOOL))
+    f.set_bit(1, 7)
+    with pytest.raises(ValueError):
+        f.set_bit(2, 7)
+    f.set_bit(0, 7)  # flip to false
+    frag = f.view(core.VIEW_STANDARD).fragment(0)
+    assert frag.contains(0, 7) and not frag.contains(1, 7)
+
+
+def test_int_field_value_roundtrip():
+    f = core.Field("i", "age", None, core.FieldOptions(field_type=core.FIELD_INT, min=-100, max=1000))
+    for col, v in [(1, 42), (2, -17), (3, 0), (SHARD_WIDTH + 5, 999)]:
+        f.set_value(col, v)
+        assert f.value(col) == (v, True)
+    assert f.value(99) == (0, False)
+    f.set_value(1, -5)  # overwrite flips sign and magnitude
+    assert f.value(1) == (-5, True)
+    f.set_value(2, 123456789)  # grow depth beyond min/max hint
+    assert f.value(2) == (123456789, True)
+    assert f.value(SHARD_WIDTH + 5) == (999, True)
+    f.clear_value(3)
+    assert f.value(3) == (0, False)
+
+
+def test_int_field_import_values_bulk():
+    f = core.Field("i", "v", None, core.FieldOptions(field_type=core.FIELD_INT))
+    cols = np.array([1, 2, 3, SHARD_WIDTH + 1], dtype=np.uint64)
+    vals = np.array([10, -20, 30, -40], dtype=np.int64)
+    f.import_values(cols, vals)
+    for c, v in zip(cols.tolist(), vals.tolist()):
+        assert f.value(c) == (v, True)
+    # overwrite with fewer bits — old high bits must be cleared
+    f.import_values(cols, np.array([1, 2, 3, 4], dtype=np.int64))
+    for c, v in zip(cols.tolist(), [1, 2, 3, 4]):
+        assert f.value(c) == (v, True)
+
+
+def test_time_field_views():
+    f = core.Field(
+        "i", "t", None,
+        core.FieldOptions(field_type=core.FIELD_TIME, time_quantum="YMD"),
+    )
+    ts = datetime(2018, 1, 2, 12)
+    f.set_bit(1, 10, timestamp=ts)
+    names = set(f.views.keys())
+    assert names == {"standard", "standard_2018", "standard_201801", "standard_20180102"}
+    for v in f.views.values():
+        assert v.fragment(0).contains(1, 10)
+
+
+# ------------------------------------------------------------- time quantum
+def test_views_by_time():
+    ts = datetime(2018, 3, 2, 5)
+    assert views_by_time("standard", ts, "YMDH") == [
+        "standard_2018",
+        "standard_201803",
+        "standard_20180302",
+        "standard_2018030205",
+    ]
+
+
+def test_views_by_time_range_minimal_cover():
+    views = views_by_time_range(
+        "standard", datetime(2017, 11, 1), datetime(2018, 2, 1), "YMD"
+    )
+    assert views == ["standard_201711", "standard_201712", "standard_201801"]
+    views = views_by_time_range(
+        "standard", datetime(2017, 12, 30), datetime(2018, 1, 3), "YMD"
+    )
+    assert views == [
+        "standard_20171230",
+        "standard_20171231",
+        "standard_20180101",
+        "standard_20180102",
+    ]
+    # full-year alignment uses the Y view
+    views = views_by_time_range(
+        "standard", datetime(2018, 1, 1), datetime(2019, 1, 1), "YMD"
+    )
+    assert views == ["standard_2018"]
+
+
+# ---------------------------------------------------------------- holder
+def test_holder_reload_roundtrip(tmp_holder_path):
+    h = core.Holder(tmp_holder_path)
+    h.open()
+    idx = h.create_index("myindex")
+    f = idx.create_field("stuff")
+    f.set_bit(1, 100)
+    f.set_bit(1, SHARD_WIDTH + 3)
+    age = idx.create_field("age", core.FieldOptions(field_type=core.FIELD_INT))
+    age.set_value(100, 31)
+    idx.mark_columns_exist(np.array([100, SHARD_WIDTH + 3], dtype=np.uint64))
+    h.close()
+
+    h2 = core.Holder(tmp_holder_path)
+    h2.open()
+    idx2 = h2.index("myindex")
+    assert idx2 is not None
+    f2 = idx2.field("stuff")
+    assert f2.view(core.VIEW_STANDARD).fragment(0).contains(1, 100)
+    assert f2.view(core.VIEW_STANDARD).fragment(1).contains(1, SHARD_WIDTH + 3)
+    assert idx2.field("age").value(100) == (31, True)
+    assert idx2.available_shards() == {0, 1}
+    schema = h2.schema()
+    assert schema[0]["name"] == "myindex"
+    names = {f["name"] for f in schema[0]["fields"]}
+    assert names == {"stuff", "age"}  # _exists hidden
+    h2.close()
+
+
+def test_index_delete_field(tmp_holder_path):
+    h = core.Holder(tmp_holder_path)
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f").set_bit(0, 0)
+    idx.delete_field("f")
+    assert idx.field("f") is None
+    with pytest.raises(KeyError):
+        idx.delete_field("f")
+    with pytest.raises(ValueError):
+        h.create_index("i")
+    h.delete_index("i")
+    assert h.index("i") is None
+
+
+# ----------------------------------------------------------------- caches
+def test_rank_cache_ordering():
+    c = core.RankCache(max_size=3)
+    for row, count in [(1, 10), (2, 30), (3, 20), (4, 5)]:
+        c.add(row, count)
+    assert c.top(2) == [(2, 30), (3, 20)]
+    c.add(2, 0)  # dropping to zero removes
+    assert c.top()[0] == (3, 20)
+
+
+def test_lru_cache_eviction():
+    c = core.LRUCache(max_size=2)
+    c.add(1, 10)
+    c.add(2, 20)
+    c.add(3, 30)
+    assert c.get(1) == 0  # evicted
+    assert c.get(2) == 20 and c.get(3) == 30
+
+
+def test_fragment_row_ids_small_shard_width(monkeypatch):
+    # containers span multiple rows when SHARD_WIDTH < 2^16
+    import pilosa_tpu.core.fragment as fragment_mod
+    monkeypatch.setattr(fragment_mod, "SHARD_WIDTH", 4096)
+    frag = core.Fragment(None, "i", "f", "standard", 0)
+    frag.open()
+    frag.bitmap.add(0 * 4096 + 1)
+    frag.bitmap.add(1 * 4096 + 2)
+    frag.bitmap.add(5 * 4096 + 3)
+    assert frag.row_ids() == [0, 1, 5]

@@ -1,0 +1,287 @@
+"""Executor tests — PQL end-to-end over the data model.
+
+Mirrors the reference's executor_test.go coverage: bitmap algebra, Count,
+BSI aggregates, TopN, Rows, GroupBy, writes, Options, keys, time ranges.
+Results cross-checked against Python-set oracles."""
+
+from datetime import datetime
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import FieldOptions, Holder, IndexOptions
+from pilosa_tpu.executor import ExecutionError, Executor
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+@pytest.fixture
+def env():
+    h = Holder(None)
+    idx = h.create_index("i")
+    return h, idx, Executor(h)
+
+
+def q(e, text, shards=None):
+    return e.execute("i", text, shards=shards)
+
+
+def test_set_and_row(env):
+    h, idx, e = env
+    idx.create_field("f")
+    assert q(e, "Set(10, f=1)") == [True]
+    assert q(e, "Set(10, f=1)") == [False]
+    q(e, f"Set({SHARD_WIDTH + 7}, f=1) Set(20, f=2)")
+    (res,) = q(e, "Row(f=1)")
+    assert res.columns().tolist() == [10, SHARD_WIDTH + 7]
+    assert res.count() == 2
+
+
+def test_bitmap_algebra_matches_sets(env, rng):
+    h, idx, e = env
+    idx.create_field("a")
+    idx.create_field("b")
+    cols_a = np.unique(rng.integers(0, SHARD_WIDTH * 3, 500, dtype=np.uint64))
+    cols_b = np.unique(rng.integers(0, SHARD_WIDTH * 3, 500, dtype=np.uint64))
+    idx.field("a").import_bulk(np.ones(cols_a.size, dtype=np.uint64), cols_a)
+    idx.field("b").import_bulk(np.ones(cols_b.size, dtype=np.uint64), cols_b)
+    idx.mark_columns_exist(np.concatenate([cols_a, cols_b]))
+    sa, sb = set(cols_a.tolist()), set(cols_b.tolist())
+
+    (r,) = q(e, "Intersect(Row(a=1), Row(b=1))")
+    assert set(r.columns().tolist()) == sa & sb
+    (r,) = q(e, "Union(Row(a=1), Row(b=1))")
+    assert set(r.columns().tolist()) == sa | sb
+    (r,) = q(e, "Difference(Row(a=1), Row(b=1))")
+    assert set(r.columns().tolist()) == sa - sb
+    (r,) = q(e, "Xor(Row(a=1), Row(b=1))")
+    assert set(r.columns().tolist()) == sa ^ sb
+    (r,) = q(e, "Not(Row(a=1))")
+    assert set(r.columns().tolist()) == (sa | sb) - sa
+    (r,) = q(e, "All()")
+    assert set(r.columns().tolist()) == sa | sb
+    assert q(e, "Count(Intersect(Row(a=1), Row(b=1)))") == [len(sa & sb)]
+
+
+def test_missing_row_and_field(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1)")
+    (r,) = q(e, "Row(f=99)")
+    assert r.count() == 0
+    with pytest.raises(ExecutionError):
+        q(e, "Row(nope=1)")
+    with pytest.raises(ExecutionError):
+        q(e, "Nonsense(Row(f=1))")
+
+
+def test_shift(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(5, f=1) Set(40, f=1)")
+    (r,) = q(e, "Shift(Row(f=1), n=3)")
+    assert r.columns().tolist() == [8, 43]
+
+
+def test_bsi_sum_min_max_range(env, rng):
+    h, idx, e = env
+    idx.create_field("f")
+    idx.create_field("v", FieldOptions(field_type="int"))
+    cols = np.arange(0, 3 * SHARD_WIDTH, 7919, dtype=np.uint64)
+    vals = rng.integers(-500, 500, cols.size, dtype=np.int64)
+    idx.field("v").import_values(cols, vals)
+    evens = cols[cols % 2 == 0]
+    idx.field("f").import_bulk(np.ones(evens.size, dtype=np.uint64), evens)
+    idx.mark_columns_exist(cols)
+
+    oracle = dict(zip(cols.tolist(), vals.tolist()))
+    assert q(e, "Sum(field=v)") == [
+        {"value": sum(oracle.values()), "count": len(oracle)}
+    ]
+    sel = {c: v for c, v in oracle.items() if c % 2 == 0}
+    assert q(e, "Sum(Row(f=1), field=v)") == [
+        {"value": sum(sel.values()), "count": len(sel)}
+    ]
+    assert q(e, "Min(field=v)")[0]["value"] == min(oracle.values())
+    assert q(e, "Max(field=v)")[0]["value"] == max(oracle.values())
+
+    (r,) = q(e, "Row(v > 100)")
+    assert set(r.columns().tolist()) == {c for c, v in oracle.items() if v > 100}
+    (r,) = q(e, "Row(-50 <= v <= 50)")
+    assert set(r.columns().tolist()) == {
+        c for c, v in oracle.items() if -50 <= v <= 50
+    }
+    (r,) = q(e, "Row(v == 0)")
+    assert set(r.columns().tolist()) == {c for c, v in oracle.items() if v == 0}
+
+
+def test_topn(env):
+    h, idx, e = env
+    idx.create_field("f")
+    # row 1: 5 cols, row 2: 3 cols, row 3: 8 cols (spread over 2 shards)
+    for row, count in [(1, 5), (2, 3), (3, 8)]:
+        cols = np.arange(count, dtype=np.uint64) * np.uint64(SHARD_WIDTH // 4)
+        idx.field("f").import_bulk(np.full(count, row, dtype=np.uint64), cols)
+    assert q(e, "TopN(f, n=2)") == [
+        [{"id": 3, "count": 8}, {"id": 1, "count": 5}]
+    ]
+    # with filter: only columns of row 3
+    (res,) = q(e, "TopN(f, Row(f=3), n=1)")
+    assert res[0]["id"] == 3 and res[0]["count"] == 8
+    # ids= form (exact recount of specific rows)
+    assert q(e, "TopN(f, ids=[1, 2])") == [
+        [{"id": 1, "count": 5}, {"id": 2, "count": 3}]
+    ]
+
+
+def test_rows(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1) Set(2, f=5) Set(3, f=9)")
+    assert q(e, "Rows(f)") == [{"rows": [1, 5, 9]}]
+    assert q(e, "Rows(f, previous=1, limit=1)") == [{"rows": [5]}]
+    assert q(e, "Rows(f, column=2)") == [{"rows": [5]}]
+
+
+def test_group_by(env):
+    h, idx, e = env
+    idx.create_field("a")
+    idx.create_field("b")
+    idx.create_field("v", FieldOptions(field_type="int"))
+    # a rows: 0,1 ; b rows: 0,1 ; columns 0..99
+    cols = np.arange(100, dtype=np.uint64)
+    idx.field("a").import_bulk(cols % 2, cols)
+    idx.field("b").import_bulk((cols // 2) % 2, cols)
+    idx.field("v").import_values(cols, np.ones(100, dtype=np.int64) * 2)
+    (res,) = q(e, "GroupBy(Rows(a), Rows(b))")
+    got = {
+        (g["group"][0]["rowID"], g["group"][1]["rowID"]): g["count"] for g in res
+    }
+    assert got == {(0, 0): 25, (0, 1): 25, (1, 0): 25, (1, 1): 25}
+    (res,) = q(e, "GroupBy(Rows(a), limit=1)")
+    assert len(res) == 1
+    (res,) = q(e, "GroupBy(Rows(a), filter=Row(b=0), aggregate=Sum(field=v))")
+    assert all(g["count"] == 25 and g["sum"] == 50 for g in res)
+
+
+def test_time_field_range_query(env):
+    h, idx, e = env
+    idx.create_field("t", FieldOptions(field_type="time", time_quantum="YMD"))
+    q(e, "Set(1, t=1, 2018-01-01T00:00) Set(2, t=1, 2018-02-01T00:00) Set(3, t=1, 2019-01-01T00:00)")
+    (r,) = q(e, "Row(t=1, from=2018-01-01, to=2018-12-31)")
+    assert set(r.columns().tolist()) == {1, 2}
+    (r,) = q(e, "Row(t=1)")  # standard view: all
+    assert set(r.columns().tolist()) == {1, 2, 3}
+
+
+def test_store_and_clear_row(env):
+    h, idx, e = env
+    idx.create_field("f")
+    idx.create_field("g")
+    q(e, "Set(1, f=1) Set(2, f=1) Set(2, g=7)")
+    q(e, "Store(Row(f=1), g=9)")
+    (r,) = q(e, "Row(g=9)")
+    assert r.columns().tolist() == [1, 2]
+    assert q(e, "ClearRow(f=1)") == [True]
+    (r,) = q(e, "Row(f=1)")
+    assert r.count() == 0
+    assert q(e, "ClearRow(f=1)") == [False]
+
+
+def test_mutex_and_bool_via_pql(env):
+    h, idx, e = env
+    idx.create_field("m", FieldOptions(field_type="mutex"))
+    idx.create_field("b", FieldOptions(field_type="bool"))
+    q(e, "Set(1, m=1) Set(1, m=2)")
+    (r1,) = q(e, "Row(m=1)")
+    (r2,) = q(e, "Row(m=2)")
+    assert r1.count() == 0 and r2.columns().tolist() == [1]
+    q(e, "Set(1, b=true) Set(1, b=false)")
+    (rt,) = q(e, "Row(b=true)")
+    (rf,) = q(e, "Row(b=false)")
+    assert rt.count() == 0 and rf.columns().tolist() == [1]
+
+
+def test_keys_translation():
+    h = Holder(None)
+    idx = h.create_index("i", IndexOptions(keys=True))
+    idx.create_field("f", FieldOptions(keys=True))
+    e = Executor(h)
+    e.execute("i", 'Set("alice", f="admin")')
+    e.execute("i", 'Set("bob", f="admin")')
+    (r,) = e.execute("i", 'Row(f="admin")')
+    assert r.keys == ["alice", "bob"]
+    (res,) = e.execute("i", "TopN(f, n=1)")
+    assert res[0]["key"] == "admin" and res[0]["count"] == 2
+    # unknown key reads as empty
+    (r,) = e.execute("i", 'Row(f="nobody")')
+    assert r.count() == 0
+
+
+def test_attrs(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, 'SetRowAttrs(f, 1, color="blue", weight=3)')
+    assert idx.field("f").row_attrs.attrs(1) == {"color": "blue", "weight": 3}
+    q(e, 'SetColumnAttrs(9, name="x")')
+    assert idx.column_attrs.attrs(9) == {"name": "x"}
+    # null deletes
+    q(e, "SetRowAttrs(f, 1, color=null)")
+    assert idx.field("f").row_attrs.attrs(1) == {"weight": 3}
+    # TopN attr filtering
+    q(e, "Set(1, f=1) Set(2, f=2)")
+    q(e, 'SetRowAttrs(f, 2, color="red")')
+    (res,) = q(e, 'TopN(f, attrName="color", attrValues=["red"])')
+    assert [p["id"] for p in res] == [2]
+
+
+def test_options_shards(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, f"Set(0, f=1) Set({SHARD_WIDTH}, f=1) Set({2 * SHARD_WIDTH}, f=1)")
+    (r,) = q(e, "Options(Row(f=1), shards=[0, 2])")
+    assert r.columns().tolist() == [0, 2 * SHARD_WIDTH]
+
+
+# ------------------------------------------------------- regression findings
+def test_bsi_compare_beyond_depth(env):
+    h, idx, e = env
+    idx.create_field("v", FieldOptions(field_type="int"))
+    cols = np.arange(5, dtype=np.uint64)
+    idx.field("v").import_values(cols, np.array([977, 1000, 100, -500, 0], dtype=np.int64))
+    (r,) = q(e, "Row(v < 2000)")
+    assert set(r.columns().tolist()) == {0, 1, 2, 3, 4}
+    (r,) = q(e, "Row(v > 2000)")
+    assert r.count() == 0
+    (r,) = q(e, "Row(v > -2000)")
+    assert set(r.columns().tolist()) == {0, 1, 2, 3, 4}
+    (r,) = q(e, "Row(v == 2000)")
+    assert r.count() == 0
+    (r,) = q(e, "Row(v != 2000)")
+    assert set(r.columns().tolist()) == {0, 1, 2, 3, 4}
+
+
+def test_negative_shift_rejected(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(5, f=1)")
+    with pytest.raises(ExecutionError):
+        q(e, "Shift(Row(f=1), n=-1)")
+
+
+def test_topn_attrname_requires_attrvalues(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1)")
+    with pytest.raises(ExecutionError):
+        q(e, 'TopN(f, attrName="color")')
+
+
+def test_open_ended_time_range(env):
+    h, idx, e = env
+    idx.create_field("t", FieldOptions(field_type="time", time_quantum="YMDH"))
+    q(e, "Set(1, t=1, 2018-06-01T00:00) Set(2, t=1, 2018-06-02T00:00)")
+    # open endpoints must bound to materialized buckets, not year 1/9999
+    (r,) = q(e, "Row(t=1, to=2018-06-02)")
+    assert set(r.columns().tolist()) == {1}
+    (r,) = q(e, "Row(t=1, from=2018-06-02)")
+    assert set(r.columns().tolist()) == {2}

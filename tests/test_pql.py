@@ -1,0 +1,132 @@
+"""PQL parser tests (reference coverage model: pql/pql_test.go)."""
+
+from datetime import datetime
+
+import pytest
+
+from pilosa_tpu import pql
+from pilosa_tpu.pql import Condition, PQLError
+
+
+def one(text):
+    calls = pql.parse(text)
+    assert len(calls) == 1
+    return calls[0]
+
+
+def test_simple_row():
+    c = one("Row(stuff=1)")
+    assert c.name == "Row" and c.args == {"stuff": 1}
+
+
+def test_string_row_key():
+    c = one('Row(stuff="blah")')
+    assert c.args == {"stuff": "blah"}
+    assert one("Row(stuff='x y')").args == {"stuff": "x y"}
+
+
+def test_nested_calls():
+    c = one("Count(Intersect(Row(a=1), Row(b=2)))")
+    assert c.name == "Count"
+    inter = c.children[0]
+    assert inter.name == "Intersect"
+    assert [ch.name for ch in inter.children] == ["Row", "Row"]
+    assert inter.children[0].args == {"a": 1}
+
+
+def test_multiple_top_level_calls():
+    calls = pql.parse("Set(1, f=2) Set(3, f=4) Count(Row(f=2))")
+    assert [c.name for c in calls] == ["Set", "Set", "Count"]
+    assert calls[0].pos_args == [1]
+    assert calls[0].args == {"f": 2}
+
+
+def test_set_with_timestamp():
+    c = one("Set(10, t=1, 2016-01-01T00:00)")
+    assert c.pos_args == [10, datetime(2016, 1, 1)]
+    assert c.args == {"t": 1}
+
+
+def test_topn_args():
+    c = one("TopN(f, n=5)")
+    assert c.pos_args == ["f"] and c.args == {"n": 5}
+    c = one("TopN(f, Row(other=1), n=3)")
+    assert c.children[0].name == "Row"
+
+
+def test_conditions():
+    assert one("Row(age > 5)").args == {"age": Condition(">", 5)}
+    assert one("Row(age >= -5)").args == {"age": Condition(">=", -5)}
+    assert one("Row(age == 10)").args == {"age": Condition("==", 10)}
+    assert one("Row(age != 10)").args == {"age": Condition("!=", 10)}
+    assert one("Range(age < 100)").args == {"age": Condition("<", 100)}
+
+
+def test_between_condition():
+    assert one("Row(5 < age < 10)").args == {"age": Condition("between", [6, 9])}
+    assert one("Row(5 <= age <= 10)").args == {"age": Condition("between", [5, 10])}
+    assert one("Row(age >< [5, 10])").args == {"age": Condition("between", [5, 10])}
+
+
+def test_time_range_row():
+    c = one("Row(t=1, from=2017-01-01, to=2018-01-01T00:00)")
+    assert c.args["t"] == 1
+    assert c.args["from"] == datetime(2017, 1, 1)
+    assert c.args["to"] == datetime(2018, 1, 1)
+
+
+def test_groupby():
+    c = one("GroupBy(Rows(a), Rows(b), limit=10, aggregate=Sum(field=v))")
+    assert [ch.name for ch in c.children] == ["Rows", "Rows"]
+    assert c.args["limit"] == 10
+    agg = c.args["aggregate"]
+    assert isinstance(agg, pql.Call) and agg.name == "Sum"
+    assert agg.args == {"field": "v"}
+
+
+def test_rows_positional_field():
+    c = one("Rows(myfield)")
+    assert c.pos_args == ["myfield"]
+    c = one("Rows(field=myfield, previous=2, limit=5)")
+    assert c.args == {"field": "myfield", "previous": 2, "limit": 5}
+
+
+def test_options_wrapper():
+    c = one("Options(Row(f=1), shards=[0, 2])")
+    assert c.name == "Options"
+    assert c.children[0].name == "Row"
+    assert c.args["shards"] == [0, 2]
+
+
+def test_lists_and_bools():
+    c = one("TopN(f, ids=[1, 2, 3], filterField=other, x=true, y=null)")
+    assert c.args["ids"] == [1, 2, 3]
+    assert c.args["x"] is True and c.args["y"] is None
+
+
+def test_store_and_all():
+    c = one("Store(Row(f=1), dest=2)")
+    assert c.children[0].name == "Row" and c.args == {"dest": 2}
+    assert one("All()").name == "All"
+
+
+def test_attr_calls():
+    c = one('SetRowAttrs(f, 1, color="blue", weight=3)')
+    assert c.pos_args == ["f", 1]
+    assert c.args == {"color": "blue", "weight": 3}
+
+
+def test_negative_rowid_and_escapes():
+    assert one("Row(f=-1)").args == {"f": -1}
+    assert one('Row(f="a\\"b")').args == {"f": 'a"b'}
+
+
+def test_parse_errors():
+    for bad in ["Row(", "Row)", "Row(f=)", "Row(f=1", "Row(1 > f > 2)", "Row(f ? 3)", "@#!"]:
+        with pytest.raises(PQLError):
+            pql.parse(bad)
+
+
+def test_repr_roundtrip_smoke():
+    c = one("GroupBy(Rows(a), limit=10)")
+    assert "GroupBy" in repr(c) and "Rows" in repr(c)
