@@ -1,0 +1,90 @@
+"""Headline benchmark: PQL Intersect+Count QPS at multi-billion-column scale.
+
+BASELINE.md config: Row(A) ∩ Row(B) + Count. The baseline is the measured
+host-CPU execution of the same workload on packed words (numpy bitwise_and
++ bitwise_count — generous to the reference: upstream pilosa's Go roaring
+loops are at best comparable to numpy's vectorized popcount at this
+density). The TPU path is the framework's fused count_and kernel over the
+same packed representation, resident in HBM.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Scale knobs via env:
+    PILOSA_BENCH_SHARDS   (default 4096  → 4096·2^20 ≈ 4.3B columns)
+    PILOSA_BENCH_CPU_ITERS / PILOSA_BENCH_TPU_ITERS
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from pilosa_tpu import ops
+    from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+
+    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "4096"))
+    cpu_iters = int(os.environ.get("PILOSA_BENCH_CPU_ITERS", "5"))
+    tpu_iters = int(os.environ.get("PILOSA_BENCH_TPU_ITERS", "50"))
+    n_words = n_shards * WORDS_PER_SHARD
+    n_columns = n_shards * SHARD_WIDTH
+
+    rng = np.random.default_rng(7)
+    # ~3% density random rows, packed (uint32 words)
+    a = rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    b = rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    # thin them to realistic density (AND of random masks ≈ 3%)
+    a &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    a &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    b &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
+    b &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
+
+    # ---------------- CPU baseline (the reference's single-node hot loop)
+    def cpu_query():
+        return int(np.bitwise_count(a & b).sum())
+
+    expect = cpu_query()  # warm page cache + correctness anchor
+    t0 = time.perf_counter()
+    for _ in range(cpu_iters):
+        got = cpu_query()
+    cpu_seconds = (time.perf_counter() - t0) / cpu_iters
+    assert got == expect
+
+    # ---------------- TPU path: fused AND+popcount, HBM-resident rows
+    dev_a = jax.device_put(a)
+    dev_b = jax.device_put(b)
+
+    @jax.jit
+    def tpu_query(x, y):
+        return ops.count_and(x, y)
+
+    result = int(tpu_query(dev_a, dev_b))  # compile + warm
+    assert result == expect, f"TPU {result} != CPU {expect}"
+    t0 = time.perf_counter()
+    for _ in range(tpu_iters):
+        out = tpu_query(dev_a, dev_b)
+    out.block_until_ready()
+    tpu_seconds = (time.perf_counter() - t0) / tpu_iters
+
+    cpu_qps = 1.0 / cpu_seconds
+    tpu_qps = 1.0 / tpu_seconds
+    print(
+        json.dumps(
+            {
+                "metric": f"intersect_count_qps_{n_columns // 10**9}B_columns",
+                "value": round(tpu_qps, 2),
+                "unit": "qps",
+                "vs_baseline": round(tpu_qps / cpu_qps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

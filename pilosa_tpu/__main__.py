@@ -1,0 +1,3 @@
+from pilosa_tpu.cli import main
+
+raise SystemExit(main())

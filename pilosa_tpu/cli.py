@@ -1,0 +1,198 @@
+"""CLI: server / import / export / config / check / inspect subcommands.
+
+Reference: cmd/pilosa/main.go + ctl/ (server.go, import.go CSV importer,
+export.go, config.go, check.go, inspect.go, generate-config). argparse
+replaces cobra; subcommand names and flag spellings follow the reference.
+
+Usage: ``python -m pilosa_tpu <subcommand> ...``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import urllib.request
+
+import numpy as np
+
+
+def _http(method: str, url: str, body: bytes | None = None, ctype: str = "application/json"):
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def cmd_server(args) -> int:
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.utils.config import load_config
+
+    cfg = load_config(
+        args.config,
+        overrides={
+            "bind": args.bind,
+            "data_dir": args.data_dir,
+            "coordinator": args.coordinator or None,
+            "seeds": args.seeds.split(",") if args.seeds else None,
+            "replica_n": args.replica_n,
+        },
+    )
+    srv = Server(cfg)
+    srv.open()
+    print(f"pilosa-tpu server listening on {srv.uri}", flush=True)
+    stop = []
+    signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            signal.pause()
+    except KeyboardInterrupt:
+        pass
+    srv.close()
+    return 0
+
+
+def cmd_import(args) -> int:
+    """CSV import: rows of `rowID,columnID[,timestamp]` or, with
+    --field-type int, `columnID,value` (reference: ctl/import.go)."""
+    rows, cols, timestamps, values = [], [], [], []
+    f = sys.stdin if args.path == "-" else open(args.path)
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            parts = line.split(",")
+            if args.values:
+                cols.append(int(parts[0]))
+                values.append(int(parts[1]))
+            else:
+                rows.append(int(parts[0]))
+                cols.append(int(parts[1]))
+                if len(parts) > 2:
+                    timestamps.append(parts[2])
+    base = f"http://{args.host}/index/{args.index}/field/{args.field}"
+    if args.create:
+        _http("POST", f"http://{args.host}/index/{args.index}", b"{}")
+        opts = {"options": {"type": "int"}} if args.values else {}
+        _http("POST", base, json.dumps(opts).encode())
+    batch = args.batch_size
+    if args.values:
+        for i in range(0, len(cols), batch):
+            payload = {"columnIDs": cols[i : i + batch], "values": values[i : i + batch]}
+            _http("POST", base + "/import-value", json.dumps(payload).encode())
+    else:
+        for i in range(0, len(cols), batch):
+            payload = {"rowIDs": rows[i : i + batch], "columnIDs": cols[i : i + batch]}
+            if timestamps:
+                payload["timestamps"] = timestamps[i : i + batch]
+            _http("POST", base + "/import", json.dumps(payload).encode())
+    print(f"imported {len(cols)} records into {args.index}/{args.field}")
+    return 0
+
+
+def cmd_export(args) -> int:
+    url = f"http://{args.host}/export?index={args.index}&field={args.field}"
+    req = urllib.request.Request(url)
+    with urllib.request.urlopen(req) as resp:
+        sys.stdout.write(resp.read().decode())
+    return 0
+
+
+def cmd_config(args) -> int:
+    from pilosa_tpu.utils.config import config_template, dump_config, load_config
+
+    if args.generate:
+        print(config_template(), end="")
+    else:
+        print(dump_config(load_config(args.config)), end="")
+    return 0
+
+
+def cmd_check(args) -> int:
+    """Validate fragment files are parseable (reference: ctl/check.go)."""
+    from pilosa_tpu import roaring
+
+    ok = True
+    for path in args.paths:
+        try:
+            with open(path, "rb") as f:
+                data = f.read()
+            bm, consumed = roaring.deserialize(data)
+            n_ops = roaring.replay_ops(bm, data[consumed:])
+            print(f"{path}: OK ({bm.count()} bits, {n_ops} ops replayed)")
+        except Exception as e:
+            ok = False
+            print(f"{path}: CORRUPT — {e}")
+    return 0 if ok else 1
+
+
+def cmd_inspect(args) -> int:
+    """Dump fragment contents (reference: ctl/inspect.go)."""
+    from pilosa_tpu import roaring
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    with open(args.path, "rb") as f:
+        data = f.read()
+    bm, consumed = roaring.deserialize(data)
+    roaring.replay_ops(bm, data[consumed:])
+    values = bm.values()
+    rows = np.unique(values // np.uint64(SHARD_WIDTH))
+    print(f"bits: {values.size}  rows: {rows.size}  ops-log bytes: {len(data) - consumed}")
+    for r in rows.tolist()[: args.max_rows]:
+        count = bm.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
+        print(f"  row {r}: {count} bits")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="pilosa-tpu")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    s = sub.add_parser("server", help="run the server")
+    s.add_argument("--bind", default=None)
+    s.add_argument("--data-dir", default=None)
+    s.add_argument("--config", default=None)
+    s.add_argument("--coordinator", action="store_true")
+    s.add_argument("--seeds", default=None, help="comma-separated peer URIs")
+    s.add_argument("--replica-n", type=int, default=None)
+    s.set_defaults(fn=cmd_server)
+
+    s = sub.add_parser("import", help="CSV import")
+    s.add_argument("path", help="CSV file or - for stdin")
+    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("-i", "--index", required=True)
+    s.add_argument("-f", "--field", required=True)
+    s.add_argument("--create", action="store_true", help="create index/field first")
+    s.add_argument("--values", action="store_true", help="columnID,value rows (int field)")
+    s.add_argument("--batch-size", type=int, default=100_000)
+    s.set_defaults(fn=cmd_import)
+
+    s = sub.add_parser("export", help="CSV export")
+    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("-i", "--index", required=True)
+    s.add_argument("-f", "--field", required=True)
+    s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser("config", help="print effective config")
+    s.add_argument("--config", default=None)
+    s.add_argument("--generate", action="store_true", help="emit a template")
+    s.set_defaults(fn=cmd_config)
+
+    s = sub.add_parser("check", help="validate fragment files")
+    s.add_argument("paths", nargs="+")
+    s.set_defaults(fn=cmd_check)
+
+    s = sub.add_parser("inspect", help="dump a fragment file")
+    s.add_argument("path")
+    s.add_argument("--max-rows", type=int, default=20)
+    s.set_defaults(fn=cmd_inspect)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

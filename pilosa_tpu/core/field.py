@@ -122,12 +122,14 @@ class Field:
         v = self.views.get(name)
         if v is None:
             view_path = os.path.join(self.path, "views", name) if self.path else None
+            # BSI views never serve TopN; skip rank-cache maintenance there
+            cache_type = "none" if name == VIEW_BSI else self.options.cache_type
             v = View(
                 name,
                 self.index,
                 self.name,
                 view_path,
-                self.options.cache_type,
+                cache_type,
                 self.options.cache_size,
             )
             self.views[name] = v
@@ -191,8 +193,8 @@ class Field:
         for view_name in self._writable_views(timestamp):
             frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
             if self.options.field_type in (FIELD_MUTEX, FIELD_BOOL) and view_name == VIEW_STANDARD:
-                for other in frag.row_ids():
-                    if other != row and frag.contains(other, col):
+                for other in frag.rows_containing(col):
+                    if other != row:
                         frag.clear_bit(other, col)
             changed |= frag.set_bit(row, col)
         return changed

@@ -219,11 +219,26 @@ class Fragment:
             self.cache.add(row, self.row_count(row))
             return True
 
+    def rows_containing(self, col: int) -> list[int]:
+        """Rows whose bit for ``col`` is set — one O(1) container probe per
+        candidate row instead of per-row range scans (mutex/bool single-
+        value enforcement; reference: fragment mutex handling)."""
+        c = col % SHARD_WIDTH
+        out = []
+        for r in range(self.n_rows()):
+            pos = r * SHARD_WIDTH + c
+            if self.bitmap.contains(pos):
+                out.append(r)
+        return out
+
     def bulk_import(self, rows: np.ndarray, cols: np.ndarray, clear: bool = False) -> None:
         """Batched set/clear (reference: fragment.bulkImport). ``cols`` are
-        absolute or in-shard column IDs; reduced mod SHARD_WIDTH."""
+        absolute or in-shard column IDs; reduced mod SHARD_WIDTH. Empty
+        batches are free (no ops-log record, no cache work)."""
         with self._lock:
             rows = np.asarray(rows, dtype=np.uint64)
+            if rows.size == 0:
+                return
             cols = np.asarray(cols, dtype=np.uint64) % np.uint64(SHARD_WIDTH)
             positions = rows * np.uint64(SHARD_WIDTH) + cols
             if clear:

@@ -186,7 +186,7 @@ class Executor:
         if frag is None:
             return self._zeros()
         m, n = frag.device_matrix()
-        if row >= n:
+        if row < 0 or row >= n:
             return self._zeros()
         return m[row]
 
@@ -272,7 +272,20 @@ class Executor:
                 raise ExecutionError(f"field {fname!r} is not an int field")
             slices = self._bsi_slices(field, shard)
             if slices is None:
+                if condition.op == "==" and condition.value is None:
+                    return self._existence_words(idx, shard)
                 return self._zeros()
+            if condition.value is None:
+                # null comparisons: f != null ⇒ has a value;
+                # f == null ⇒ exists in the index but has no value
+                exists = slices[0]
+                if condition.op == "!=":
+                    return exists
+                if condition.op == "==":
+                    return ops.w_andnot(self._existence_words(idx, shard), exists)
+                raise ExecutionError(
+                    f"null only supports ==/!= comparisons, got {condition.op!r}"
+                )
             if condition.op == "between":
                 lo, hi = condition.value
                 return ops.bsi.between(slices, int(lo), int(hi))
@@ -326,7 +339,7 @@ class Executor:
     def _agg_field(self, idx: Index, call: Call) -> Field:
         field = self._field(idx, self._call_field_name(call))
         if field.options.field_type != FIELD_INT:
-            raise ExecutionError(f"field {fname!r} is not an int field")
+            raise ExecutionError(f"field {field.name!r} is not an int field")
         return field
 
     def _execute_sum(self, idx: Index, call: Call, shards: list[int]) -> SumCount:

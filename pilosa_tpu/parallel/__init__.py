@@ -1,0 +1,20 @@
+"""L3 cluster + device-mesh parallelism.
+
+Reference: cluster.go, gossip/, broadcast.go, http/client.go. Two scales of
+parallelism live here:
+
+- ``topology`` / ``cluster`` / ``client``: host-level scale-out — hash
+  partitioning, replica chains, HTTP scatter-gather, anti-entropy;
+- ``mesh``: chip-level scale-out — jax.sharding.Mesh execution of whole
+  query batches with psum reductions over ICI (replaces the reference's
+  per-node goroutine hot loop AND its HTTP reduce for intra-pod shards).
+"""
+
+from pilosa_tpu.parallel.topology import (
+    PARTITION_N,
+    Node,
+    Topology,
+    partition,
+)
+
+__all__ = ["Node", "Topology", "partition", "PARTITION_N"]

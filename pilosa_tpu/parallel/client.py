@@ -1,0 +1,152 @@
+"""Internal node→node HTTP client — the data-plane communication backend.
+
+Reference: http/client.go (InternalClient: QueryNode, Import, ImportRoaring,
+FragmentBlocks, BlockData, RetrieveShardFromURI, SendMessage). JSON bodies
+(with base64 roaring payloads for bitmap data) over HTTP; every call takes
+the peer's base URI so one client serves all peers.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import urllib.error
+import urllib.request
+
+
+class PeerError(RuntimeError):
+    def __init__(self, uri: str, detail: str):
+        super().__init__(f"peer {uri}: {detail}")
+        self.uri = uri
+
+
+class InternalClient:
+    def __init__(self, timeout: float = 30.0):
+        self.timeout = timeout
+
+    def _request(
+        self, method: str, uri: str, path: str, body: bytes | None = None
+    ) -> bytes:
+        req = urllib.request.Request(uri + path, data=body, method=method)
+        if body is not None:
+            req.add_header("Content-Type", "application/json")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")
+            raise PeerError(uri, f"HTTP {e.code}: {detail}") from e
+        except OSError as e:
+            raise PeerError(uri, str(e)) from e
+
+    def _json(self, method: str, uri: str, path: str, body: dict | None = None) -> dict:
+        payload = json.dumps(body).encode() if body is not None else None
+        return json.loads(self._request(method, uri, path, payload) or b"{}")
+
+    # ------------------------------------------------------------ queries
+    def query_node(
+        self, uri: str, index: str, pql: str, shards: list[int] | None
+    ) -> list[dict]:
+        """Execute PQL on a peer restricted to given shards; returns typed
+        result JSON (reference: InternalClient.QueryNode)."""
+        resp = self._json(
+            "POST",
+            uri,
+            "/internal/query",
+            {"index": index, "query": pql, "shards": shards},
+        )
+        return resp["results"]
+
+    def node_shards(self, uri: str, index: str) -> list[int]:
+        resp = self._json("GET", uri, f"/internal/shards?index={index}")
+        return resp["shards"]
+
+    def status(self, uri: str) -> dict:
+        return self._json("GET", uri, "/status")
+
+    # ------------------------------------------------------------ imports
+    def import_node(
+        self, uri: str, index: str, field: str, payload: dict, values: bool
+    ) -> None:
+        kind = "import-value" if values else "import"
+        self._json(
+            "POST", uri, f"/internal/{kind}/{index}/{field}", payload
+        )
+
+    def import_roaring(
+        self, uri: str, index: str, field: str, view: str, shard: int, data: bytes
+    ) -> None:
+        self._request(
+            "POST",
+            uri,
+            f"/index/{index}/field/{field}/import-roaring/{shard}?view={view}",
+            data,
+        )
+
+    # ------------------------------------------------------- anti-entropy
+    def fragment_blocks(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> dict[int, str]:
+        """block id → checksum hex (reference: FragmentBlocks)."""
+        resp = self._json(
+            "GET",
+            uri,
+            f"/internal/fragment/blocks?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+        )
+        return {int(b["block"]): b["checksum"] for b in resp["blocks"]}
+
+    def block_data(
+        self, uri: str, index: str, field: str, view: str, shard: int, block: int
+    ) -> tuple[list[int], list[int]]:
+        resp = self._json(
+            "GET",
+            uri,
+            f"/internal/fragment/block/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}&block={block}",
+        )
+        return resp["rows"], resp["cols"]
+
+    def retrieve_fragment(
+        self, uri: str, index: str, field: str, view: str, shard: int
+    ) -> bytes:
+        """Full fragment contents as serialized roaring (reference:
+        RetrieveShardFromURI)."""
+        raw = self._request(
+            "GET",
+            uri,
+            f"/internal/fragment/data?index={index}&field={field}"
+            f"&view={view}&shard={shard}",
+        )
+        return raw
+
+    def fragment_inventory(self, uri: str, index: str) -> list[dict]:
+        """[{field, view, shard}] a peer holds for an index."""
+        resp = self._json("GET", uri, f"/internal/fragment/inventory?index={index}")
+        return resp["fragments"]
+
+    # ------------------------------------------------------- translation
+    def translate_entries(
+        self, uri: str, index: str, field: str | None, offset: int
+    ) -> list[tuple[str, int]]:
+        path = f"/internal/translate/data?index={index}&offset={offset}"
+        if field:
+            path += f"&field={field}"
+        resp = self._json("GET", uri, path)
+        return [(e["k"], e["id"]) for e in resp["entries"]]
+
+    # --------------------------------------------------------- broadcast
+    def send_schema(self, uri: str, schema: dict) -> None:
+        self._json("POST", uri, "/schema", schema)
+
+
+def encode_words_b64(words) -> str:
+    import numpy as np
+
+    return base64.b64encode(np.asarray(words, dtype=np.uint32).tobytes()).decode()
+
+
+def decode_words_b64(data: str):
+    import numpy as np
+
+    return np.frombuffer(base64.b64decode(data), dtype=np.uint32).copy()

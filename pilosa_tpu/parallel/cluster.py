@@ -1,0 +1,757 @@
+"""Cluster: static membership, scatter-gather routing, anti-entropy,
+join recovery.
+
+Reference: cluster.go (cluster, ResizeJob, states), gossip/ (memberlist),
+broadcast.go, holder_syncer.go, executor.go (mapReduce/mapperRemote).
+Design departures, deliberate for the TPU-era stack:
+
+- membership is a static seed list + HTTP heartbeats instead of memberlist
+  gossip — the same fixed-process-group model as ``jax.distributed``;
+  elasticity is join-time pull recovery (a new node fetches fragments it
+  now owns) rather than a coordinator-driven ResizeJob push;
+- node→node payloads are JSON with base64 roaring/packed words instead of
+  protobuf (see parallel/client.py);
+- schema changes broadcast by POSTing the full schema to peers
+  (apply_schema is idempotent), replacing CreateIndex/CreateField messages.
+
+Read fan-out: every shard is executed by its first alive owner ("primary");
+per-call results reduce with type-specific merges (counts add, row segments
+concatenate — shards are disjoint column ranges; TopN/GroupBy merge by key).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu.executor import RowResult
+from pilosa_tpu.executor.executor import WRITE_CALLS
+from pilosa_tpu.parallel.client import (
+    InternalClient,
+    PeerError,
+    decode_words_b64,
+    encode_words_b64,
+)
+from pilosa_tpu.parallel.topology import (
+    STATE_DEGRADED,
+    STATE_NORMAL,
+    STATE_STARTING,
+    Node,
+    Topology,
+)
+from pilosa_tpu.pql import Call, parse
+from pilosa_tpu.roaring import serialize
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+HEARTBEAT_INTERVAL = 2.0
+
+
+class ShardUnavailableError(RuntimeError):
+    pass
+
+
+class Cluster:
+    def __init__(self, server):
+        self.server = server
+        self.config = server.config
+        self.client = InternalClient()
+        me = Node(
+            id=self.config.node_id,
+            uri=server.uri,
+            is_coordinator=self.config.coordinator,
+        )
+        peers = [
+            Node(id=uri.replace("http://", ""), uri=uri)
+            for uri in self.config.seeds
+            if uri.rstrip("/") != server.uri
+        ]
+        self.topology = Topology([me] + peers, replica_n=self.config.replica_n)
+        self.me = me
+        self.state = STATE_STARTING
+        self._hb_timer: threading.Timer | None = None
+        self._closed = False
+
+    # ------------------------------------------------------------ membership
+    @property
+    def nodes(self) -> list[Node]:
+        return self.topology.nodes
+
+    def open(self) -> None:
+        self._mount_internal_routes()
+        self.server.http.query_router = self.query
+        self.server.http.import_router = self.import_router
+        self.server.http.broadcast_schema = self.broadcast_schema
+        self._heartbeat_once()
+        self._recover_on_join()
+        self.state = STATE_NORMAL
+        self._schedule_heartbeat()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._hb_timer is not None:
+            self._hb_timer.cancel()
+
+    def _peers(self, alive_only: bool = True) -> list[Node]:
+        return [
+            n
+            for n in self.nodes
+            if n.id != self.me.id and (n.alive or not alive_only)
+        ]
+
+    def _heartbeat_once(self) -> None:
+        degraded = False
+        for n in self._peers(alive_only=False):
+            try:
+                self.client.status(n.uri)
+                n.alive = True
+            except PeerError:
+                n.alive = False
+                degraded = True
+        if self.state in (STATE_NORMAL, STATE_DEGRADED):
+            self.state = STATE_DEGRADED if degraded else STATE_NORMAL
+
+    def _schedule_heartbeat(self) -> None:
+        if self._closed:
+            return
+
+        def tick():
+            try:
+                self._heartbeat_once()
+            finally:
+                self._schedule_heartbeat()
+
+        self._hb_timer = threading.Timer(HEARTBEAT_INTERVAL, tick)
+        self._hb_timer.daemon = True
+        self._hb_timer.start()
+
+    def shard_nodes(self, index: str, shard: int) -> list[Node]:
+        return self.topology.shard_nodes(index, shard)
+
+    def _probe_alive(self, node: Node) -> bool:
+        """Current liveness; re-probes a dead-marked peer once so a write
+        never relies on a stale heartbeat (a skipped owner means silent
+        data loss)."""
+        if node.id == self.me.id or node.alive:
+            return True
+        try:
+            self.client.status(node.uri)
+            node.alive = True
+        except PeerError:
+            node.alive = False
+        return node.alive
+
+    # ---------------------------------------------------------- join recovery
+    def _recover_on_join(self) -> None:
+        """Pull schema and any fragments this node owns but lacks (the
+        elastic-resize analogue of the reference's ResizeJob)."""
+        api = self.server.api
+        for peer in self._peers():
+            try:
+                schema = self.client._json("GET", peer.uri, "/schema")
+            except PeerError:
+                continue
+            api.apply_schema(schema)
+            for idx_name in [i["name"] for i in schema.get("indexes", [])]:
+                try:
+                    inventory = self.client.fragment_inventory(peer.uri, idx_name)
+                except PeerError:
+                    continue
+                for frag_info in inventory:
+                    shard = frag_info["shard"]
+                    if not self.topology.owns(self.me.id, idx_name, shard):
+                        continue
+                    field = frag_info["field"]
+                    view = frag_info["view"]
+                    if self._local_fragment(idx_name, field, view, shard) is not None:
+                        continue
+                    try:
+                        data = self.client.retrieve_fragment(
+                            peer.uri, idx_name, field, view, shard
+                        )
+                        api.import_roaring(idx_name, field, shard, data, view=view)
+                    except PeerError:
+                        continue
+
+    def _local_fragment(self, index: str, field: str, view: str, shard: int):
+        idx = self.server.holder.index(index)
+        f = idx.field(field) if idx else None
+        v = f.view(view) if f else None
+        return v.fragment(shard) if v else None
+
+    # ------------------------------------------------------------- broadcast
+    def broadcast_schema(self) -> None:
+        # attempt every peer, even ones marked dead — a peer that just came
+        # up should not miss schema changes while awaiting the next heartbeat
+        schema = self.server.api.schema()
+        for n in self._peers(alive_only=False):
+            try:
+                self.client.send_schema(n.uri, schema)
+                n.alive = True
+            except PeerError:
+                pass
+
+    # ----------------------------------------------------------- shard scan
+    def global_shards(self, index: str) -> list[int]:
+        idx = self.server.holder.index(index)
+        shards: set[int] = set(idx.available_shards()) if idx else set()
+        for n in self._peers():
+            try:
+                shards.update(self.client.node_shards(n.uri, index))
+            except PeerError:
+                pass
+        return sorted(shards)
+
+    # -------------------------------------------------------------- queries
+    def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
+        calls = parse(pql)
+        results = []
+        for call in calls:
+            if call.name in WRITE_CALLS:
+                results.append(self._route_write(index, call))
+            else:
+                results.append(self._route_read(index, call, shards))
+        return {"results": [self.server.api._result_json(r) for r in results]}
+
+    def _route_read(self, index: str, call: Call, shards: list[int] | None) -> Any:
+        call = self._translate_read_keys(index, call)
+        all_shards = shards if shards is not None else self.global_shards(index)
+        if not all_shards:
+            all_shards = [0]
+        by_node: dict[str, list[int]] = {}
+        node_by_id = {n.id: n for n in self.nodes}
+        for s in all_shards:
+            primary = next(
+                (n for n in self.shard_nodes(index, s) if self._probe_alive(n)),
+                None,
+            )
+            if primary is None:
+                raise ShardUnavailableError(f"no alive owner for shard {s}")
+            by_node.setdefault(primary.id, []).append(s)
+
+        partials: list[Any] = []
+        for node_id, node_shards in by_node.items():
+            if node_id == self.me.id:
+                partials.extend(
+                    self.server.api.executor.execute(index, [call], shards=node_shards)
+                )
+            else:
+                remote = self.client.query_node(
+                    node_by_id[node_id].uri, index, call.to_pql(), node_shards
+                )
+                partials.extend(decode_result(r) for r in remote)
+        result = reduce_results(call, partials)
+        if isinstance(result, RowResult):
+            self._attach_column_keys(index, result)
+        return result
+
+    def _translate_read_keys(self, index: str, call: Call) -> Call:
+        """Rewrite string row keys to IDs before fan-out, consulting the
+        translate primary for keys this node hasn't seen. Unknown keys
+        become -1 (reads as an empty row)."""
+        idx = self.server.holder.index(index)
+        if idx is None:
+            return call
+        new_args = dict(call.args)
+        for k, v in call.args.items():
+            f = idx.field(k)
+            if isinstance(v, str) and f is not None and f.options.keys:
+                rid = self._row_key_lookup(index, k, v)
+                new_args[k] = rid if rid is not None else -1
+        children = [self._translate_read_keys(index, ch) for ch in call.children]
+        return Call(call.name, new_args, children, list(call.pos_args))
+
+    def _row_key_lookup(self, index: str, field: str, key: str) -> int | None:
+        f = self.server.holder.index(index).field(field)
+        rid = f.row_keys.translate_key(key, create=False)
+        if rid is not None:
+            return rid
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            return None
+        try:
+            resp = self.client._json(
+                "POST",
+                primary.uri,
+                "/internal/translate/create",
+                {"index": index, "field": field, "keys": [key], "create": False},
+            )
+        except PeerError:
+            return None
+        rid = resp["ids"][0]
+        if rid is not None:
+            f.row_keys.apply_entries([(key, rid)])
+        return rid
+
+    def _attach_column_keys(self, index: str, res: RowResult) -> None:
+        idx = self.server.holder.index(index)
+        if idx is None or not idx.options.keys:
+            return
+        cols = res.columns().tolist()
+        if any(idx.column_keys.translate_id(c) is None for c in cols):
+            # tail the primary's full translation log to fill gaps
+            primary = self._translate_primary()
+            if primary.id != self.me.id:
+                try:
+                    entries = self.client.translate_entries(primary.uri, index, None, 0)
+                    idx.column_keys.apply_entries(entries)
+                except PeerError:
+                    pass
+        res.keys = [idx.column_keys.translate_id(c) or str(c) for c in cols]
+
+    def _route_write(self, index: str, call: Call) -> Any:
+        # single-column writes go to every owner of the column's shard;
+        # row-wide / attr writes broadcast to every node
+        if call.name in ("Set", "Clear") and call.pos_args:
+            col = call.pos_args[0]
+            if isinstance(col, str):
+                col_id = self.translate_column_key(index, col)
+                call = Call(call.name, dict(call.args), list(call.children),
+                            [col_id] + list(call.pos_args[1:]))
+            else:
+                col_id = col
+            # row keys also need cluster-consistent translation
+            fa = call.field_arg()
+            if fa is not None and isinstance(fa[1], str):
+                fname, key = fa
+                row_id = self.translate_row_key(index, fname, key)
+                new_args = dict(call.args)
+                new_args[fname] = row_id
+                call = Call(call.name, new_args, list(call.children), list(call.pos_args))
+            shard = col_id // SHARD_WIDTH
+            result = None
+            for owner in self.shard_nodes(index, shard):
+                if not self._probe_alive(owner):
+                    continue
+                if owner.id == self.me.id:
+                    r = self.server.api.executor.execute(index, [call])[0]
+                else:
+                    r = decode_result(
+                        self.client.query_node(owner.uri, index, call.to_pql(), [shard])[0]
+                    )
+                result = r if result is None else result
+            if result is None:
+                raise ShardUnavailableError(f"no alive owner for shard {shard}")
+            return result
+        # broadcast writes
+        result: Any = None
+        for n in self.nodes:
+            if not self._probe_alive(n):
+                continue
+            if n.id == self.me.id:
+                r = self.server.api.executor.execute(index, [call])[0]
+            else:
+                r = decode_result(
+                    self.client.query_node(n.uri, index, call.to_pql(), None)[0]
+                )
+            if isinstance(r, bool):
+                result = bool(result) | r
+            else:
+                result = r if result is None else result
+        return result
+
+    # -------------------------------------------------------------- imports
+    def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
+        api = self.server.api
+        idx = self.server.holder.index(index)
+        if idx is None:
+            raise ValueError(f"index {index!r} not found")
+        # cluster-consistent key translation through the primary
+        if payload.get("columnKeys"):
+            payload = dict(payload)
+            payload["columnIDs"] = [
+                self.translate_column_key(index, k) for k in payload.pop("columnKeys")
+            ]
+        if payload.get("rowKeys"):
+            payload = dict(payload)
+            payload["rowIDs"] = [
+                self.translate_row_key(index, field, k) for k in payload.pop("rowKeys")
+            ]
+        cols = np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
+        shards = cols // np.uint64(SHARD_WIDTH)
+        for shard in np.unique(shards).tolist():
+            m = shards == shard
+            sub = dict(payload)
+            sub["columnIDs"] = cols[m].tolist()
+            if values:
+                vals = payload.get("values", [])
+                sub["values"] = [vals[i] for i in np.flatnonzero(m).tolist()]
+            else:
+                rows = payload.get("rowIDs", [])
+                sub["rowIDs"] = [rows[i] for i in np.flatnonzero(m).tolist()]
+                ts = payload.get("timestamps")
+                if ts:
+                    sub["timestamps"] = [ts[i] for i in np.flatnonzero(m).tolist()]
+            delivered = 0
+            for owner in self.shard_nodes(index, int(shard)):
+                if not self._probe_alive(owner):
+                    continue
+                if owner.id == self.me.id:
+                    if values:
+                        api.import_values(index, field, sub)
+                    else:
+                        api.import_bits(index, field, sub)
+                else:
+                    self.client.import_node(owner.uri, index, field, sub, values)
+                delivered += 1
+            if delivered == 0:
+                raise ShardUnavailableError(
+                    f"no alive owner for shard {int(shard)}; import rejected"
+                )
+
+    # ---------------------------------------------------------- translation
+    def _translate_primary(self) -> Node:
+        """The sorted-first alive node owns key allocation (reference:
+        translate.go primary/replica design)."""
+        for n in self.nodes:
+            if n.alive:
+                return n
+        raise ShardUnavailableError("no alive nodes for key translation")
+
+    def translate_column_key(self, index: str, key: str) -> int:
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            idx = self.server.holder.index(index)
+            return idx.column_keys.translate_key(key, create=True)
+        resp = self.client._json(
+            "POST",
+            primary.uri,
+            "/internal/translate/create",
+            {"index": index, "keys": [key]},
+        )
+        return resp["ids"][0]
+
+    def translate_row_key(self, index: str, field: str, key: str) -> int:
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            f = self.server.holder.index(index).field(field)
+            return f.row_keys.translate_key(key, create=True)
+        resp = self.client._json(
+            "POST",
+            primary.uri,
+            "/internal/translate/create",
+            {"index": index, "field": field, "keys": [key]},
+        )
+        return resp["ids"][0]
+
+    # --------------------------------------------------------- anti-entropy
+    def sync_holder(self) -> None:
+        """Block-checksum diff + union merge against replica peers
+        (reference: holderSyncer.SyncHolder), then tail key translations
+        from the primary."""
+        holder = self.server.holder
+        for idx_name, idx in list(holder.indexes.items()):
+            for f_name, f in list(idx.fields.items()):
+                for v_name, view in list(f.views.items()):
+                    for shard, frag in list(view.fragments.items()):
+                        owners = self.shard_nodes(idx_name, shard)
+                        for owner in owners:
+                            if owner.id == self.me.id or not owner.alive:
+                                continue
+                            try:
+                                self._sync_fragment(
+                                    idx_name, f_name, v_name, shard, frag, owner
+                                )
+                            except PeerError:
+                                continue
+        self._tail_translations()
+
+    def _sync_fragment(self, index, field, view, shard, frag, peer: Node) -> None:
+        theirs = self.client.fragment_blocks(peer.uri, index, field, view, shard)
+        mine = {b: c.hex() for b, c in frag.block_checksums()}
+        for block in set(theirs) | set(mine):
+            if theirs.get(block) == mine.get(block):
+                continue
+            if block not in theirs:
+                continue  # peer missing data; its own AE pass will pull ours
+            rows, cols = self.client.block_data(
+                peer.uri, index, field, view, shard, block
+            )
+            local_rows, local_cols = frag.block_data(block)
+            merged = set(zip(local_rows.tolist(), local_cols.tolist())) | set(
+                zip(rows, cols)
+            )
+            if merged:
+                mr, mc = zip(*sorted(merged))
+            else:
+                mr, mc = (), ()
+            frag.merge_block(
+                block,
+                np.asarray(mr, dtype=np.uint64),
+                np.asarray(mc, dtype=np.uint64),
+            )
+
+    def _tail_translations(self) -> None:
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            return
+        for idx_name, idx in self.server.holder.indexes.items():
+            if idx.options.keys:
+                try:
+                    offset = max(idx.column_keys._by_id, default=0)
+                    entries = self.client.translate_entries(
+                        primary.uri, idx_name, None, offset
+                    )
+                    idx.column_keys.apply_entries(entries)
+                except PeerError:
+                    pass
+            for f_name, f in idx.fields.items():
+                if f.options.keys:
+                    try:
+                        offset = max(f.row_keys._by_id, default=0)
+                        entries = self.client.translate_entries(
+                            primary.uri, idx_name, f_name, offset
+                        )
+                        f.row_keys.apply_entries(entries)
+                    except PeerError:
+                        pass
+
+    # ------------------------------------------------------ internal routes
+    def _mount_internal_routes(self) -> None:
+        import re
+
+        http = self.server.http
+        routes = {
+            ("POST", re.compile(r"^/internal/query$")): self._h_query,
+            ("GET", re.compile(r"^/internal/shards$")): self._h_shards,
+            ("GET", re.compile(r"^/internal/fragment/blocks$")): self._h_blocks,
+            ("GET", re.compile(r"^/internal/fragment/block/data$")): self._h_block_data,
+            ("GET", re.compile(r"^/internal/fragment/data$")): self._h_fragment_data,
+            ("GET", re.compile(r"^/internal/fragment/inventory$")): self._h_inventory,
+            (
+                "POST",
+                re.compile(r"^/internal/import/([^/]+)/([^/]+)$"),
+            ): self._h_import_bits,
+            (
+                "POST",
+                re.compile(r"^/internal/import-value/([^/]+)/([^/]+)$"),
+            ): self._h_import_values,
+            ("GET", re.compile(r"^/internal/translate/data$")): self._h_translate_data,
+            (
+                "POST",
+                re.compile(r"^/internal/translate/create$"),
+            ): self._h_translate_create,
+        }
+        http.extra_routes.update(routes)
+
+    # each handler receives the live request Handler object
+    def _h_query(self, handler) -> None:
+        body = handler._json_body()
+        results = self.server.api.executor.execute(
+            body["index"], body["query"], shards=body.get("shards")
+        )
+        handler._json({"results": [encode_result(r) for r in results]})
+
+    def _h_shards(self, handler) -> None:
+        index = handler.query_params["index"][0]
+        idx = self.server.holder.index(index)
+        handler._json(
+            {"shards": sorted(idx.available_shards()) if idx else []}
+        )
+
+    def _frag_from_params(self, handler):
+        p = handler.query_params
+        return self._local_fragment(
+            p["index"][0], p["field"][0], p.get("view", ["standard"])[0],
+            int(p["shard"][0]),
+        )
+
+    def _h_blocks(self, handler) -> None:
+        frag = self._frag_from_params(handler)
+        blocks = frag.block_checksums() if frag else []
+        handler._json(
+            {"blocks": [{"block": b, "checksum": c.hex()} for b, c in blocks]}
+        )
+
+    def _h_block_data(self, handler) -> None:
+        frag = self._frag_from_params(handler)
+        block = int(handler.query_params["block"][0])
+        if frag is None:
+            handler._json({"rows": [], "cols": []})
+            return
+        rows, cols = frag.block_data(block)
+        handler._json({"rows": rows.tolist(), "cols": cols.tolist()})
+
+    def _h_fragment_data(self, handler) -> None:
+        frag = self._frag_from_params(handler)
+        data = serialize(frag.bitmap) if frag else serialize_empty()
+        handler.send_response(200)
+        handler.send_header("Content-Type", "application/octet-stream")
+        handler.send_header("Content-Length", str(len(data)))
+        handler.end_headers()
+        handler.wfile.write(data)
+
+    def _h_inventory(self, handler) -> None:
+        index = handler.query_params["index"][0]
+        idx = self.server.holder.index(index)
+        frags = []
+        if idx is not None:
+            for f_name, f in idx.fields.items():
+                for v_name, view in f.views.items():
+                    for shard in view.fragments:
+                        frags.append(
+                            {"field": f_name, "view": v_name, "shard": shard}
+                        )
+        handler._json({"fragments": frags})
+
+    def _h_import_bits(self, handler, index: str, field: str) -> None:
+        self.server.api.import_bits(index, field, handler._json_body())
+        handler._json({"success": True})
+
+    def _h_import_values(self, handler, index: str, field: str) -> None:
+        self.server.api.import_values(index, field, handler._json_body())
+        handler._json({"success": True})
+
+    def _h_translate_data(self, handler) -> None:
+        p = handler.query_params
+        index = p["index"][0]
+        offset = int(p.get("offset", ["0"])[0])
+        idx = self.server.holder.index(index)
+        if idx is None:
+            handler._json({"entries": []})
+            return
+        store = (
+            idx.field(p["field"][0]).row_keys if "field" in p else idx.column_keys
+        )
+        entries, _last = store.entries_from(offset)
+        handler._json({"entries": [{"k": k, "id": i} for k, i in entries]})
+
+    def _h_translate_create(self, handler) -> None:
+        body = handler._json_body()
+        idx = self.server.holder.index(body["index"])
+        store = (
+            idx.field(body["field"]).row_keys if body.get("field") else idx.column_keys
+        )
+        ids = store.translate_keys(body["keys"], create=body.get("create", True))
+        handler._json({"ids": ids})
+
+
+def serialize_empty() -> bytes:
+    from pilosa_tpu.roaring import Bitmap
+
+    return serialize(Bitmap())
+
+
+# --------------------------------------------------------- result transport
+def encode_result(r: Any) -> dict:
+    if isinstance(r, RowResult):
+        return {
+            "type": "row",
+            "segments": {
+                str(s): encode_words_b64(w) for s, w in r.segments.items()
+            },
+        }
+    if isinstance(r, bool):
+        return {"type": "bool", "value": r}
+    if isinstance(r, int):
+        return {"type": "count", "value": r}
+    if isinstance(r, dict) and "value" in r and "count" in r:
+        return {"type": "valCount", "value": r["value"], "count": r["count"]}
+    if isinstance(r, dict) and "rows" in r:
+        return {"type": "rowIDs", **r}
+    if isinstance(r, list):
+        if r and "group" in r[0]:
+            return {"type": "groups", "groups": r}
+        return {"type": "pairs", "pairs": r}
+    if r is None:
+        return {"type": "null"}
+    raise TypeError(f"cannot encode result {r!r}")
+
+
+def decode_result(d: dict) -> Any:
+    t = d["type"]
+    if t == "row":
+        return RowResult({int(s): decode_words_b64(w) for s, w in d["segments"].items()})
+    if t == "bool":
+        return d["value"]
+    if t == "count":
+        return d["value"]
+    if t == "valCount":
+        return {"value": d["value"], "count": d["count"]}
+    if t == "rowIDs":
+        return {k: v for k, v in d.items() if k != "type"}
+    if t == "groups":
+        return d["groups"]
+    if t == "pairs":
+        return d["pairs"]
+    if t == "null":
+        return None
+    raise TypeError(f"cannot decode result {d!r}")
+
+
+def reduce_results(call: Call, partials: list[Any]) -> Any:
+    """Merge per-node partial results (reference: executor.go per-call
+    reducers)."""
+    if not partials:
+        return None
+    first = partials[0]
+    if isinstance(first, RowResult):
+        merged = RowResult({})
+        for p in partials:
+            merged.segments.update(p.segments)  # shards are disjoint
+        return merged
+    if isinstance(first, bool):
+        return any(partials)
+    if isinstance(first, int):
+        return sum(partials)
+    if isinstance(first, dict) and "value" in first and "count" in first:
+        if call.name == "Sum":
+            return {
+                "value": sum(p["value"] for p in partials),
+                "count": sum(p["count"] for p in partials),
+            }
+        # Min/Max merge
+        want_max = call.name == "Max"
+        best = None
+        for p in partials:
+            if p["count"] == 0:
+                continue
+            if best is None or (
+                p["value"] > best["value"] if want_max else p["value"] < best["value"]
+            ):
+                best = dict(p)
+            elif p["value"] == best["value"]:
+                best["count"] += p["count"]
+        return best or {"value": 0, "count": 0}
+    if isinstance(first, dict) and "rows" in first:
+        rows = sorted(set().union(*(set(p["rows"]) for p in partials)))
+        limit = call.arg("limit")
+        if limit is not None:
+            rows = rows[:limit]
+        return {"rows": rows}
+    if isinstance(first, list):
+        sample = next((p[0] for p in partials if p), None)
+        if sample is not None and isinstance(sample, dict) and "group" in sample:
+            merged: dict[tuple, dict] = {}
+            for p in partials:
+                for g in p:
+                    key = tuple(
+                        (e["field"], e["rowID"]) for e in g["group"]
+                    )
+                    if key in merged:
+                        merged[key]["count"] += g["count"]
+                        if "sum" in g:
+                            merged[key]["sum"] = merged[key].get("sum", 0) + g["sum"]
+                    else:
+                        merged[key] = dict(g)
+            out = list(merged.values())
+            limit = call.arg("limit")
+            if limit is not None:
+                out = out[:limit]
+            return out
+        # TopN pairs: counts add across nodes (each node counted disjoint shards)
+        counts: dict[int, dict] = {}
+        for p in partials:
+            for pair in p:
+                if pair["id"] in counts:
+                    counts[pair["id"]]["count"] += pair["count"]
+                else:
+                    counts[pair["id"]] = dict(pair)
+        pairs = sorted(counts.values(), key=lambda pr: (-pr["count"], pr["id"]))
+        n = call.arg("n")
+        if n is not None:
+            pairs = pairs[:n]
+        return pairs
+    return first

@@ -1,0 +1,216 @@
+"""Device-mesh query execution: whole-index programs under one pjit.
+
+Reference mapping (SURVEY.md §3 parallelism inventory): the reference's
+only parallelism is shard scatter-gather over HTTP (executor.go mapReduce →
+mapperLocal goroutines / mapperRemote HTTP). On a TPU pod the same shards
+live as one stacked dense array across a ``jax.sharding.Mesh`` and the
+reduce is an XLA collective over ICI, not an HTTP merge:
+
+- mesh axis ``"shards"``  — data parallelism over the column space
+  (shard s ↔ column range [s·SHARD_WIDTH, (s+1)·SHARD_WIDTH));
+- mesh axis ``"words"``   — intra-shard parallelism over the packed word
+  dimension: one logical row is a distributed bit-vector, the long-context
+  / sequence-parallel analogue (a 10B-column row never materializes on one
+  chip); cross-device ops on it are elementwise, only aggregations
+  communicate (psum tree over ICI).
+
+Arrays:
+    row matrix   uint32[S, R, W]  sharded P("shards", None, "words")
+    row/filter   uint32[S, W]     sharded P("shards", "words")
+    BSI slices   uint32[S, D, W]  sharded P("shards", None, "words")
+
+All counts psum over both axes; TopN does a words-then-shards psum of the
+per-row count vector, then a replicated top_k (the reference's two-phase
+merge collapses into one collective).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pilosa_tpu import ops
+from pilosa_tpu.ops import bsi as bsi_ops
+
+AXIS_SHARDS = "shards"
+AXIS_WORDS = "words"
+_BOTH = (AXIS_SHARDS, AXIS_WORDS)
+
+
+def make_mesh(devices=None, words_axis: int = 1) -> Mesh:
+    """2-D device mesh (shards × words). ``words_axis`` > 1 splits the
+    packed word dimension across devices (for giant rows); defaults to 1
+    so every device owns whole shards."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if n % words_axis:
+        raise ValueError(f"{n} devices not divisible by words_axis={words_axis}")
+    grid = np.array(devices).reshape(n // words_axis, words_axis)
+    return Mesh(grid, (AXIS_SHARDS, AXIS_WORDS))
+
+
+class MeshQueryEngine:
+    """Compiles and caches sharded query programs over a fixed mesh."""
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+
+    # ------------------------------------------------------------ placement
+    def spec_matrix(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(AXIS_SHARDS, None, AXIS_WORDS))
+
+    def spec_row(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(AXIS_SHARDS, AXIS_WORDS))
+
+    def place_matrix(self, stacked: np.ndarray):
+        """uint32[S, R, W] → device, sharded over (shards, words)."""
+        return jax.device_put(stacked, self.spec_matrix())
+
+    def place_row(self, stacked: np.ndarray):
+        """uint32[S, W] → device."""
+        return jax.device_put(stacked, self.spec_row())
+
+    # ------------------------------------------------------------- programs
+    @functools.cached_property
+    def count_and(self):
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(AXIS_SHARDS, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            out_specs=P(),
+        )
+        def prog(a, b):
+            local = jnp.sum(jax.lax.population_count(a & b).astype(jnp.int64))
+            return jax.lax.psum(jax.lax.psum(local, AXIS_WORDS), AXIS_SHARDS)
+
+        return prog
+
+    @functools.cached_property
+    def topn(self):
+        """(matrix [S,R,W], filt [S,W]) → per-row global counts int64[R]
+        (psum over both axes; top_k happens on the replicated vector)."""
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            out_specs=P(),
+        )
+        def counts_prog(matrix, filt):
+            local = jnp.sum(
+                jax.lax.population_count(matrix & filt[:, None, :]).astype(jnp.int64),
+                axis=(0, 2),
+            )
+            return jax.lax.psum(jax.lax.psum(local, AXIS_WORDS), AXIS_SHARDS)
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def prog(matrix, filt, k: int):
+            counts = counts_prog(matrix, filt)
+            k = min(k, counts.shape[0])
+            vals, ids = jax.lax.top_k(counts, k)
+            return vals, ids.astype(jnp.int32)
+
+        return prog
+
+    @functools.cached_property
+    def bsi_sum(self):
+        """(slices [S,D,W], filt [S,W]) → (sum int64, count int64)."""
+
+        @jax.jit
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            out_specs=(P(), P()),
+        )
+        def prog(slices, filt):
+            exists = slices[:, bsi_ops.EXISTS_ROW]
+            sign = slices[:, bsi_ops.SIGN_ROW]
+            mag = slices[:, bsi_ops.OFFSET_ROW :]
+            pos = (exists & ~sign & filt)[:, None, :]
+            neg = (exists & sign & filt)[:, None, :]
+            depth = mag.shape[1]
+            weights = jnp.asarray([1 << k for k in range(depth)], dtype=jnp.int64)
+            pc = jnp.sum(
+                jax.lax.population_count(mag & pos).astype(jnp.int64), axis=(0, 2)
+            )
+            nc = jnp.sum(
+                jax.lax.population_count(mag & neg).astype(jnp.int64), axis=(0, 2)
+            )
+            local_sum = jnp.sum((pc - nc) * weights)
+            local_n = jnp.sum(
+                jax.lax.population_count(exists & filt).astype(jnp.int64)
+            )
+            total = jax.lax.psum(jax.lax.psum(local_sum, AXIS_WORDS), AXIS_SHARDS)
+            n = jax.lax.psum(jax.lax.psum(local_n, AXIS_WORDS), AXIS_SHARDS)
+            return total, n
+
+        return prog
+
+    @functools.cached_property
+    def ingest_and_aggregate(self):
+        """The full "step": apply a packed write delta to the row matrix
+        (device-side ingest, the donated-buffer mutation path) then compute
+        the standing aggregates — one compiled program, zero host round
+        trips (reference analogue: fragment.bulkImport + executor pass).
+
+        (matrix [S,R,W], delta [S,R,W], filt [S,W])
+            → (new_matrix, per-row counts int64[R], total int64)
+        """
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(
+                P(AXIS_SHARDS, None, AXIS_WORDS),
+                P(AXIS_SHARDS, None, AXIS_WORDS),
+                P(AXIS_SHARDS, AXIS_WORDS),
+            ),
+            out_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(), P()),
+        )
+        def prog(matrix, delta, filt):
+            new_matrix = matrix | delta
+            local_counts = jnp.sum(
+                jax.lax.population_count(new_matrix & filt[:, None, :]).astype(
+                    jnp.int64
+                ),
+                axis=(0, 2),
+            )
+            counts = jax.lax.psum(
+                jax.lax.psum(local_counts, AXIS_WORDS), AXIS_SHARDS
+            )
+            total = jnp.sum(counts)
+            return new_matrix, counts, total
+
+        return jax.jit(prog, donate_argnums=(0,))
+
+
+def stack_field_matrices(field, shards: list[int]) -> np.ndarray:
+    """Stack a field's standard-view fragment matrices → uint32[S, R, W]
+    (host-side; rows padded to the max across shards)."""
+    from pilosa_tpu.core import VIEW_STANDARD
+    from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+    view = field.view(VIEW_STANDARD)
+    mats = []
+    max_rows = 1
+    for s in shards:
+        frag = view.fragment(s) if view else None
+        if frag is None:
+            mats.append(None)
+        else:
+            m, n = frag.device_matrix()
+            mats.append(np.asarray(m))
+            max_rows = max(max_rows, m.shape[0])
+    out = np.zeros((len(shards), max_rows, WORDS_PER_SHARD), dtype=np.uint32)
+    for i, m in enumerate(mats):
+        if m is not None:
+            out[i, : m.shape[0]] = m
+    return out

@@ -58,3 +58,38 @@ class Call:
         parts += [f"{v!r}" for v in self.pos_args]
         parts += [f"{k}={v!r}" for k, v in self.args.items()]
         return f"{self.name}({', '.join(parts)})"
+
+    def to_pql(self) -> str:
+        """Render back to parseable PQL text (used to forward single calls
+        to peer nodes — reference ships protobuf-serialized Calls instead)."""
+        parts = [c.to_pql() for c in self.children]
+        parts += [_render_value(v) for v in self.pos_args]
+        for k, v in self.args.items():
+            if isinstance(v, Condition):
+                if v.op == "between":
+                    lo, hi = v.value
+                    parts.append(f"{_render_value(lo)} <= {k} <= {_render_value(hi)}")
+                else:
+                    parts.append(f"{k} {v.op} {_render_value(v.value)}")
+            else:
+                parts.append(f"{k}={_render_value(v)}")
+        return f"{self.name}({', '.join(parts)})"
+
+
+def _render_value(v: Any) -> str:
+    from datetime import datetime
+
+    if isinstance(v, Call):
+        return v.to_pql()
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "null"
+    if isinstance(v, datetime):
+        return v.strftime("%Y-%m-%dT%H:%M:%S")
+    if isinstance(v, str):
+        escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(v, list):
+        return "[" + ", ".join(_render_value(x) for x in v) + "]"
+    return str(v)

@@ -32,7 +32,7 @@ _TOKEN_RE = re.compile(
     r"""
     (?P<ws>\s+)
   | (?P<timestamp>\d{4}-\d{2}-\d{2}(?:T\d{2}:\d{2}(?::\d{2})?)?)
-  | (?P<float>-?\d+\.\d+)
+  | (?P<float>-?\d+\.\d+(?:[eE][+-]?\d+)?|-?\d+[eE][+-]?\d+)
   | (?P<int>-?\d+)
   | (?P<name>[A-Za-z_][A-Za-z0-9_-]*)
   | (?P<string>"(?:[^"\\]|\\.)*"|'(?:[^'\\]|\\.)*')
@@ -172,8 +172,11 @@ class _Parser:
             else:
                 call.args[name] = Condition(_COND_FROM_OP[op], self.parse_value())
             return
-        # between prefix form:  value < name < value
-        if t.kind in ("int", "float", "timestamp") and self.peek(1).kind == "op":
+        # between prefix form:  value < name < value  (integers only —
+        # BSI conditions are integer comparisons)
+        if t.kind == "timestamp" and self.peek(1).kind == "op":
+            raise PQLError(f"timestamps are not valid in conditions at {t.pos}")
+        if t.kind in ("int", "float") and self.peek(1).kind == "op":
             lo = self.next().value
             op1 = self.next().value
             if self.peek().kind != "name":

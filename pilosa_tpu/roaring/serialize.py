@@ -9,7 +9,7 @@ structure mirrors the reference: a header cookie, per-container metadata
 after the snapshot which are replayed on load.
 
 Layout (little-endian):
-    header:   uint16 magic=12348 | uint16 version=0 | uint32 n_containers
+    header:   uint16 magic=12348 | uint16 version=1 | uint32 n_containers
     metadata: n × (uint64 key | uint16 type | uint16 pad | uint32 cardinality)
     offsets:  n × uint64 (byte offset of payload from file start)
     payloads: array: n×uint16; bitmap: 1024×uint64; run: n_runs×(2×uint16),
@@ -29,7 +29,7 @@ from pilosa_tpu.roaring import containers as ct
 from pilosa_tpu.roaring.bitmap import Bitmap
 
 MAGIC = 12348
-VERSION = 0
+VERSION = 1  # v1: uint64 payload offsets (v0 used uint32)
 OP_MAGIC = 0xF1
 OP_ADD = 1
 OP_REMOVE = 2

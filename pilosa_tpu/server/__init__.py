@@ -1,0 +1,10 @@
+"""L5/L6: API façade, HTTP transport, server runtime.
+
+Reference: api.go, http/handler.go, server.go, server/ (config wiring).
+"""
+
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import HTTPServer
+from pilosa_tpu.server.server import Server
+
+__all__ = ["API", "HTTPServer", "Server"]

@@ -1,0 +1,237 @@
+"""Programmatic API façade — the single surface the HTTP layer and tests
+call.
+
+Reference: api.go (pilosa.API: Query, CreateIndex/Field, DeleteIndex/Field,
+Import, ImportValue, ImportRoaring, Schema, ApplySchema, ExportCSV,
+ShardNodes, Hosts, State, Info). Serialization of results to JSON lives
+here so transport layers stay thin.
+"""
+
+from __future__ import annotations
+
+import io
+from datetime import datetime
+from typing import Any
+
+import numpy as np
+
+from pilosa_tpu import __version__
+from pilosa_tpu.core import (
+    FIELD_INT,
+    VIEW_STANDARD,
+    Field,
+    FieldOptions,
+    Holder,
+    Index,
+    IndexOptions,
+)
+from pilosa_tpu.executor import ExecutionError, Executor, RowResult
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+def field_options_from_json(opts: dict) -> FieldOptions:
+    """Map the reference's JSON field-options wire names onto FieldOptions
+    (reference: http/handler.go postFieldRequest)."""
+    return FieldOptions(
+        field_type=opts.get("type", "set"),
+        cache_type=opts.get("cacheType", "ranked"),
+        cache_size=opts.get("cacheSize", 50_000),
+        time_quantum=opts.get("timeQuantum", ""),
+        keys=opts.get("keys", False),
+        min=opts.get("min", 0),
+        max=opts.get("max", 0),
+        no_standard_view=opts.get("noStandardView", False),
+    )
+
+
+class API:
+    def __init__(self, holder: Holder, cluster=None, stats=None):
+        self.holder = holder
+        self.cluster = cluster  # None ⇒ single-node
+        self.executor = Executor(holder)
+        self.stats = stats
+
+    # ------------------------------------------------------------- schema
+    def create_index(self, name: str, options: dict | None = None) -> Index:
+        opts = options or {}
+        idx = self.holder.create_index(
+            name,
+            IndexOptions(
+                keys=opts.get("keys", False),
+                track_existence=opts.get("trackExistence", True),
+            ),
+        )
+        return idx
+
+    def delete_index(self, name: str) -> None:
+        self.holder.delete_index(name)
+
+    def create_field(self, index: str, name: str, options: dict | None = None) -> Field:
+        idx = self._index(index)
+        return idx.create_field(name, field_options_from_json(options or {}))
+
+    def delete_field(self, index: str, name: str) -> None:
+        self._index(index).delete_field(name)
+
+    def schema(self) -> dict:
+        return {"indexes": self.holder.schema()}
+
+    def apply_schema(self, schema: dict) -> None:
+        """Idempotently create everything in a schema dump (reference:
+        api.ApplySchema)."""
+        for idx_def in schema.get("indexes", []):
+            opts = idx_def.get("options", {})
+            idx = self.holder.create_index_if_not_exists(
+                idx_def["name"],
+                IndexOptions(
+                    keys=opts.get("keys", False),
+                    track_existence=opts.get("trackExistence", True),
+                ),
+            )
+            for f_def in idx_def.get("fields", []):
+                if idx.field(f_def["name"]) is None:
+                    idx.create_field(
+                        f_def["name"], field_options_from_json(f_def.get("options", {}))
+                    )
+
+    # -------------------------------------------------------------- query
+    def query(
+        self, index: str, pql: str, shards: list[int] | None = None
+    ) -> dict:
+        results = self.executor.execute(index, pql, shards=shards)
+        return {"results": [self._result_json(r) for r in results]}
+
+    def _result_json(self, r: Any) -> Any:
+        if isinstance(r, RowResult):
+            return r.to_json()
+        if r is None:
+            return None
+        return r
+
+    # ------------------------------------------------------------- import
+    def import_bits(self, index: str, field: str, payload: dict) -> None:
+        """Bulk bit import (reference: api.Import / ImportRequest).
+
+        payload keys: rowIDs|rowKeys, columnIDs|columnKeys, timestamps
+        (epoch seconds or ISO strings, optional), clear (optional).
+        """
+        idx = self._index(index)
+        f = self._field(idx, field)
+        rows = self._resolve_rows(f, payload)
+        cols = self._resolve_cols(idx, payload)
+        if rows.size != cols.size:
+            raise ExecutionError("rowIDs and columnIDs length mismatch")
+        timestamps = None
+        raw_ts = payload.get("timestamps")
+        if raw_ts:
+            timestamps = [self._parse_ts(t) for t in raw_ts]
+        f.import_bulk(rows, cols, timestamps=timestamps, clear=payload.get("clear", False))
+        idx.mark_columns_exist(cols)
+
+    def import_values(self, index: str, field: str, payload: dict) -> None:
+        """Bulk BSI import (reference: api.ImportValue)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        cols = self._resolve_cols(idx, payload)
+        values = np.asarray(payload.get("values", []), dtype=np.int64)
+        if cols.size != values.size:
+            raise ExecutionError("columnIDs and values length mismatch")
+        f.import_values(cols, values)
+        idx.mark_columns_exist(cols)
+
+    def import_roaring(self, index: str, field: str, shard: int, data: bytes, view: str = VIEW_STANDARD) -> None:
+        """Direct roaring-bitmap union into a fragment (reference:
+        api.ImportRoaring fast path)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        frag = f.create_view_if_not_exists(view).create_fragment_if_not_exists(shard)
+        frag.import_roaring(data)
+        idx.mark_columns_exist(frag.bitmap.values() % np.uint64(SHARD_WIDTH) + np.uint64(shard * SHARD_WIDTH))
+
+    def _resolve_rows(self, f: Field, payload: dict) -> np.ndarray:
+        if "rowKeys" in payload and payload["rowKeys"]:
+            if not f.options.keys:
+                raise ExecutionError(f"field {f.name!r} does not use string keys")
+            ids = f.row_keys.translate_keys(payload["rowKeys"], create=True)
+            return np.asarray(ids, dtype=np.uint64)
+        return np.asarray(payload.get("rowIDs", []), dtype=np.uint64)
+
+    def _resolve_cols(self, idx: Index, payload: dict) -> np.ndarray:
+        if "columnKeys" in payload and payload["columnKeys"]:
+            if not idx.options.keys:
+                raise ExecutionError(f"index {idx.name!r} does not use string keys")
+            ids = idx.column_keys.translate_keys(payload["columnKeys"], create=True)
+            return np.asarray(ids, dtype=np.uint64)
+        return np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
+
+    @staticmethod
+    def _parse_ts(t: Any) -> datetime | None:
+        if t in (None, 0, ""):
+            return None
+        if isinstance(t, (int, float)):
+            return datetime.utcfromtimestamp(t)
+        return datetime.fromisoformat(t)
+
+    # ------------------------------------------------------------- export
+    def export_csv(self, index: str, field: str, shard: int | None = None) -> str:
+        """CSV rows of (rowID/key, columnID/key) pairs (reference:
+        api.ExportCSV)."""
+        idx = self._index(index)
+        f = self._field(idx, field)
+        view = f.view(VIEW_STANDARD)
+        out = io.StringIO()
+        if view is None:
+            return ""
+        shards = sorted(view.available_shards())
+        if shard is not None:
+            shards = [s for s in shards if s == shard]
+        for s in shards:
+            frag = view.fragment(s)
+            for row in frag.row_ids():
+                row_repr = (
+                    f.row_keys.translate_id(row) or str(row)
+                    if f.options.keys
+                    else str(row)
+                )
+                for col in frag.row_columns(row).tolist():
+                    col_repr = (
+                        idx.column_keys.translate_id(col) or str(col)
+                        if idx.options.keys
+                        else str(col)
+                    )
+                    out.write(f"{row_repr},{col_repr}\n")
+        return out.getvalue()
+
+    # -------------------------------------------------------------- info
+    def info(self) -> dict:
+        return {
+            "shardWidth": SHARD_WIDTH,
+            "version": __version__,
+        }
+
+    def state(self) -> str:
+        return self.cluster.state if self.cluster is not None else "NORMAL"
+
+    def hosts(self) -> list[dict]:
+        if self.cluster is not None:
+            return [n.to_json() for n in self.cluster.nodes]
+        return [{"id": "local", "uri": "", "isCoordinator": True}]
+
+    def shard_nodes(self, index: str, shard: int) -> list[dict]:
+        if self.cluster is not None:
+            return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]
+        return self.hosts()
+
+    # ------------------------------------------------------------ helpers
+    def _index(self, name: str) -> Index:
+        idx = self.holder.index(name)
+        if idx is None:
+            raise ExecutionError(f"index {name!r} not found")
+        return idx
+
+    @staticmethod
+    def _field(idx: Index, name: str) -> Field:
+        f = idx.field(name)
+        if f is None:
+            raise ExecutionError(f"field {name!r} not found")
+        return f

@@ -1,0 +1,280 @@
+"""HTTP transport: the reference's route surface over the API façade.
+
+Reference: http/handler.go (gorilla/mux routes). JSON replaces protobuf as
+the primary wire format (content negotiation hook kept); routes and
+payload field names match the reference so existing clients port over:
+
+    POST   /index/{index}/query?shards=0,2
+    POST   /index/{index}                    DELETE /index/{index}
+    GET    /index/{index}
+    POST   /index/{index}/field/{field}      DELETE /index/{index}/field/{field}
+    POST   /index/{index}/field/{field}/import
+    POST   /index/{index}/field/{field}/import-value
+    POST   /index/{index}/field/{field}/import-roaring/{shard}
+    GET    /schema        POST /schema
+    GET    /status  /info  /version  /metrics  /debug/vars  /debug/traces
+    GET    /export?index=i&field=f
+    GET    /internal/fragment/nodes?index=i&shard=3
+    (further /internal/* data-plane routes live in the cluster layer)
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from pilosa_tpu import __version__
+from pilosa_tpu.executor import ExecutionError
+from pilosa_tpu.pql import PQLError
+from pilosa_tpu.utils import GLOBAL_TRACER, StatsClient
+
+_ROUTES: list[tuple[str, re.Pattern, str]] = [
+    ("POST", re.compile(r"^/index/([^/]+)/query$"), "query"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)/import$"), "import_bits"),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)/import-value$"), "import_values"),
+    (
+        "POST",
+        re.compile(r"^/index/([^/]+)/field/([^/]+)/import-roaring/(\d+)$"),
+        "import_roaring",
+    ),
+    ("POST", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "create_field"),
+    ("DELETE", re.compile(r"^/index/([^/]+)/field/([^/]+)$"), "delete_field"),
+    ("POST", re.compile(r"^/index/([^/]+)$"), "create_index"),
+    ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
+    ("GET", re.compile(r"^/index/([^/]+)$"), "get_index"),
+    ("GET", re.compile(r"^/schema$"), "get_schema"),
+    ("POST", re.compile(r"^/schema$"), "post_schema"),
+    ("GET", re.compile(r"^/status$"), "status"),
+    ("GET", re.compile(r"^/info$"), "info"),
+    ("GET", re.compile(r"^/version$"), "version"),
+    ("GET", re.compile(r"^/metrics$"), "metrics"),
+    ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/export$"), "export"),
+    ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
+]
+
+
+class Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    server_version = "pilosa-tpu/" + __version__
+
+    # quiet default request logging; stats cover it
+    def log_message(self, fmt, *args):
+        pass
+
+    @property
+    def api(self):
+        return self.server.api
+
+    @property
+    def stats(self) -> StatsClient:
+        return self.server.stats
+
+    def _dispatch(self, method: str) -> None:
+        parsed = urlparse(self.path)
+        self.query_params = parse_qs(parsed.query)
+        for m, pattern, name in _ROUTES:
+            if m != method:
+                continue
+            match = pattern.match(parsed.path)
+            if match:
+                self.stats.count("http_requests", tags={"route": name})
+                try:
+                    with GLOBAL_TRACER.span(f"http.{name}"):
+                        getattr(self, "h_" + name)(*match.groups())
+                except (ExecutionError, PQLError, ValueError, KeyError) as e:
+                    self._json({"error": str(e)}, code=400)
+                except BrokenPipeError:
+                    pass
+                except Exception as e:  # internal error
+                    self._json({"error": f"internal: {e!r}"}, code=500)
+                return
+        handled = self.server.handle_extra(self, method, parsed.path)
+        if not handled:
+            self._json({"error": "not found"}, code=404)
+
+    def do_GET(self):
+        self._dispatch("GET")
+
+    def do_POST(self):
+        self._dispatch("POST")
+
+    def do_DELETE(self):
+        self._dispatch("DELETE")
+
+    # ------------------------------------------------------------- helpers
+    def _body(self) -> bytes:
+        length = int(self.headers.get("Content-Length") or 0)
+        return self.rfile.read(length) if length else b""
+
+    def _json_body(self) -> dict:
+        body = self._body()
+        if not body:
+            return {}
+        try:
+            return json.loads(body)
+        except json.JSONDecodeError as e:
+            raise ValueError(f"bad JSON body: {e}") from e
+
+    def _json(self, obj, code: int = 200) -> None:
+        data = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _text(self, text: str, content_type: str = "text/plain", code: int = 200) -> None:
+        data = text.encode()
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _shards_param(self) -> list[int] | None:
+        raw = self.query_params.get("shards")
+        if not raw:
+            return None
+        return [int(s) for s in raw[0].split(",") if s != ""]
+
+    # -------------------------------------------------------------- routes
+    def h_query(self, index: str) -> None:
+        pql = self._body().decode()
+        with self.stats.timer("query_seconds", tags={"index": index}):
+            resp = self.server.query_router(index, pql, self._shards_param())
+        self._json(resp)
+
+    def h_create_index(self, index: str) -> None:
+        body = self._json_body()
+        self.api.create_index(index, body.get("options", {}))
+        self.server.broadcast_schema()
+        self._json({"success": True})
+
+    def h_delete_index(self, index: str) -> None:
+        self.api.delete_index(index)
+        self.server.broadcast_schema()
+        self._json({"success": True})
+
+    def h_get_index(self, index: str) -> None:
+        for idx in self.api.schema()["indexes"]:
+            if idx["name"] == index:
+                self._json(idx)
+                return
+        self._json({"error": f"index {index!r} not found"}, code=404)
+
+    def h_create_field(self, index: str, field: str) -> None:
+        body = self._json_body()
+        self.api.create_field(index, field, body.get("options", {}))
+        self.server.broadcast_schema()
+        self._json({"success": True})
+
+    def h_delete_field(self, index: str, field: str) -> None:
+        self.api.delete_field(index, field)
+        self.server.broadcast_schema()
+        self._json({"success": True})
+
+    def h_import_bits(self, index: str, field: str) -> None:
+        self.server.import_router(index, field, self._json_body(), values=False)
+        self._json({"success": True})
+
+    def h_import_values(self, index: str, field: str) -> None:
+        self.server.import_router(index, field, self._json_body(), values=True)
+        self._json({"success": True})
+
+    def h_import_roaring(self, index: str, field: str, shard: str) -> None:
+        view = self.query_params.get("view", ["standard"])[0]
+        self.api.import_roaring(index, field, int(shard), self._body(), view=view)
+        self._json({"success": True})
+
+    def h_get_schema(self) -> None:
+        self._json(self.api.schema())
+
+    def h_post_schema(self) -> None:
+        self.api.apply_schema(self._json_body())
+        self._json({"success": True})
+
+    def h_status(self) -> None:
+        self._json(
+            {
+                "state": self.api.state(),
+                "nodes": self.api.hosts(),
+                "localID": self.server.node_id,
+            }
+        )
+
+    def h_info(self) -> None:
+        self._json(self.api.info())
+
+    def h_version(self) -> None:
+        self._json({"version": __version__})
+
+    def h_metrics(self) -> None:
+        self._text(self.stats.prometheus(), content_type="text/plain; version=0.0.4")
+
+    def h_debug_vars(self) -> None:
+        self._json(self.stats.expvar())
+
+    def h_debug_traces(self) -> None:
+        self._json({"spans": GLOBAL_TRACER.recent()})
+
+    def h_export(self) -> None:
+        index = self.query_params.get("index", [None])[0]
+        field = self.query_params.get("field", [None])[0]
+        if not index or not field:
+            raise ValueError("export requires index= and field= params")
+        shard = self.query_params.get("shard", [None])[0]
+        csv = self.api.export_csv(index, field, int(shard) if shard else None)
+        self._text(csv, content_type="text/csv")
+
+    def h_fragment_nodes(self) -> None:
+        index = self.query_params.get("index", [None])[0]
+        shard = self.query_params.get("shard", ["0"])[0]
+        if not index:
+            raise ValueError("index= required")
+        self._json(self.api.shard_nodes(index, int(shard)))
+
+
+class HTTPServer(ThreadingHTTPServer):
+    """HTTP front end bound to an API façade.
+
+    ``query_router`` / ``import_router`` default to local execution; the
+    cluster layer swaps them for scatter-gather versions. ``handle_extra``
+    lets the cluster layer mount /internal/* data-plane routes.
+    """
+
+    daemon_threads = True
+
+    def __init__(self, addr: tuple[str, int], api, stats: StatsClient | None = None):
+        super().__init__(addr, Handler)
+        self.api = api
+        self.stats = stats or StatsClient()
+        self.node_id = "local"
+        self.extra_routes: dict = {}
+        self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
+        self.import_router = self._local_import
+        self.broadcast_schema = lambda: None
+
+    def _local_import(self, index: str, field: str, payload: dict, values: bool) -> None:
+        if values:
+            self.api.import_values(index, field, payload)
+        else:
+            self.api.import_bits(index, field, payload)
+
+    def handle_extra(self, handler: Handler, method: str, path: str) -> bool:
+        for (m, pattern), fn in self.extra_routes.items():
+            if m == method:
+                match = pattern.match(path)
+                if match:
+                    fn(handler, *match.groups())
+                    return True
+        return False
+
+    def serve_background(self) -> threading.Thread:
+        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t.start()
+        return t

@@ -1,0 +1,84 @@
+"""Server runtime: lifecycle wiring of holder, API, HTTP, background loops.
+
+Reference: server.go (Server, Open, anti-entropy ticker, receiveMessage,
+monitorRuntime) + server/server.go (Command wiring). Single-node by
+default; passing seeds in the config attaches the cluster layer
+(pilosa_tpu.parallel.cluster) which swaps in scatter-gather routers and
+the /internal/* data-plane routes.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.server.api import API
+from pilosa_tpu.server.http import HTTPServer
+from pilosa_tpu.utils import StatsClient
+from pilosa_tpu.utils.config import Config
+
+
+class Server:
+    def __init__(self, config: Config | None = None):
+        self.config = config or Config()
+        self.holder = Holder(os.path.expanduser(self.config.data_dir))
+        self.stats = StatsClient()
+        self.cluster = None
+        self.api = API(self.holder, stats=self.stats)
+        self.http: HTTPServer | None = None
+        self._anti_entropy_timer: threading.Timer | None = None
+        self._closed = False
+
+    def open(self) -> None:
+        """holder load → cluster join → HTTP up → background loops
+        (reference: Server.Open)."""
+        self.holder.open()
+        self.http = HTTPServer(
+            (self.config.host, self.config.port), self.api, stats=self.stats
+        )
+        self.http.node_id = self.config.node_id
+        if self.config.seeds or self.config.coordinator:
+            from pilosa_tpu.parallel.cluster import Cluster
+
+            self.cluster = Cluster(self)
+            self.api.cluster = self.cluster
+            self.cluster.open()
+        self.http.serve_background()
+        self._schedule_anti_entropy()
+
+    def _schedule_anti_entropy(self) -> None:
+        interval = self.config.anti_entropy_interval
+        if interval <= 0 or self._closed:
+            return
+
+        def tick():
+            try:
+                if self.cluster is not None:
+                    self.cluster.sync_holder()
+            finally:
+                self._schedule_anti_entropy()
+
+        self._anti_entropy_timer = threading.Timer(interval, tick)
+        self._anti_entropy_timer.daemon = True
+        self._anti_entropy_timer.start()
+
+    @property
+    def port(self) -> int:
+        """Actual bound port (useful when config requested :0)."""
+        return self.http.server_address[1] if self.http else self.config.port
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.config.host}:{self.port}"
+
+    def close(self) -> None:
+        self._closed = True
+        if self._anti_entropy_timer is not None:
+            self._anti_entropy_timer.cancel()
+        if self.cluster is not None:
+            self.cluster.close()
+        if self.http is not None:
+            self.http.shutdown()
+            self.http.server_close()
+        self.holder.close()

@@ -1,0 +1,122 @@
+"""Configuration: TOML file + PILOSA_TPU_* env vars + CLI flags.
+
+Reference: server/config.go (three-layer TOML + PILOSA_* env + pflags;
+`pilosa config` prints the effective config, generate-config emits a
+template). Same precedence: flags > env > file > defaults.
+"""
+
+from __future__ import annotations
+
+import os
+import tomllib
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class Config:
+    bind: str = "127.0.0.1:10101"
+    data_dir: str = "~/.pilosa_tpu"
+    # cluster
+    name: str = ""  # node id; derived from bind when empty
+    coordinator: bool = False
+    seeds: list[str] = field(default_factory=list)  # peer URIs
+    replica_n: int = 1
+    # background loops
+    anti_entropy_interval: float = 600.0  # seconds; 0 disables
+    # limits
+    max_writes_per_request: int = 5000
+    # metrics
+    metric_service: str = "prometheus"
+
+    @property
+    def host(self) -> str:
+        return self.bind.split(":")[0]
+
+    @property
+    def port(self) -> int:
+        return int(self.bind.split(":")[1])
+
+    @property
+    def uri(self) -> str:
+        return f"http://{self.bind}"
+
+    @property
+    def node_id(self) -> str:
+        return self.name or self.bind
+
+
+_ENV_PREFIX = "PILOSA_TPU_"
+
+
+def _coerce(value: str, default):
+    """Coerce an env string to the type of the field's default value."""
+    if isinstance(default, bool):
+        return value.lower() in ("1", "true", "yes")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, list):
+        return [s for s in value.split(",") if s]
+    return value
+
+
+def load_config(
+    path: str | None = None, env: dict | None = None, overrides: dict | None = None
+) -> Config:
+    """defaults ← TOML file ← env ← explicit overrides (CLI flags)."""
+    cfg = Config()
+    if path:
+        with open(path, "rb") as f:
+            data = tomllib.load(f)
+        for f_def in fields(Config):
+            key = f_def.name.replace("_", "-")
+            if key in data:
+                setattr(cfg, f_def.name, data[key])
+            elif f_def.name in data:
+                setattr(cfg, f_def.name, data[f_def.name])
+    env = env if env is not None else os.environ
+    defaults = Config()
+    for f_def in fields(Config):
+        env_key = _ENV_PREFIX + f_def.name.upper()
+        if env_key in env:
+            setattr(
+                cfg,
+                f_def.name,
+                _coerce(env[env_key], getattr(defaults, f_def.name)),
+            )
+    for k, v in (overrides or {}).items():
+        if v is not None:
+            setattr(cfg, k, v)
+    return cfg
+
+
+def config_template() -> str:
+    """TOML template (reference: `pilosa generate-config`)."""
+    return (
+        'bind = "127.0.0.1:10101"\n'
+        'data-dir = "~/.pilosa_tpu"\n'
+        'name = ""\n'
+        "coordinator = false\n"
+        "seeds = []\n"
+        "replica-n = 1\n"
+        "anti-entropy-interval = 600.0\n"
+        "max-writes-per-request = 5000\n"
+        'metric-service = "prometheus"\n'
+    )
+
+
+def dump_config(cfg: Config) -> str:
+    out = []
+    for f_def in fields(Config):
+        v = getattr(cfg, f_def.name)
+        key = f_def.name.replace("_", "-")
+        if isinstance(v, str):
+            out.append(f'{key} = "{v}"')
+        elif isinstance(v, bool):
+            out.append(f"{key} = {str(v).lower()}")
+        elif isinstance(v, list):
+            out.append(f"{key} = {v!r}")
+        else:
+            out.append(f"{key} = {v}")
+    return "\n".join(out) + "\n"

@@ -1,0 +1,105 @@
+"""Metrics: counters/gauges/timers with expvar-style JSON and Prometheus
+text exposition.
+
+Reference: stats.go (StatsClient interface with tags), stats/ adapters
+(statsd/expvar) and the /metrics Prometheus route. One in-process registry
+replaces the adapter zoo; both wire formats read from it.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+
+class StatsClient:
+    def __init__(self, prefix: str = "pilosa_tpu"):
+        self.prefix = prefix
+        self._lock = threading.Lock()
+        self._counters: dict[tuple, float] = defaultdict(float)
+        self._gauges: dict[tuple, float] = {}
+        self._timings: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+
+    @staticmethod
+    def _key(name: str, tags: dict | None) -> tuple:
+        return (name, tuple(sorted((tags or {}).items())))
+
+    def count(self, name: str, value: float = 1, tags: dict | None = None) -> None:
+        with self._lock:
+            self._counters[self._key(name, tags)] += value
+
+    def gauge(self, name: str, value: float, tags: dict | None = None) -> None:
+        with self._lock:
+            self._gauges[self._key(name, tags)] = value
+
+    def timing(self, name: str, seconds: float, tags: dict | None = None) -> None:
+        with self._lock:
+            entry = self._timings[self._key(name, tags)]
+            entry[0] += 1
+            entry[1] += seconds
+
+    def timer(self, name: str, tags: dict | None = None):
+        """Context manager recording elapsed seconds."""
+        client = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                client.timing(name, time.perf_counter() - self.t0, tags)
+                return False
+
+        return _Timer()
+
+    # ------------------------------------------------------------- output
+    def expvar(self) -> dict:
+        """JSON snapshot (reference: /debug/vars)."""
+        with self._lock:
+            fmt = lambda k: k[0] + (
+                "{" + ",".join(f"{t}={v}" for t, v in k[1]) + "}" if k[1] else ""
+            )
+            return {
+                "counters": {fmt(k): v for k, v in self._counters.items()},
+                "gauges": {fmt(k): v for k, v in self._gauges.items()},
+                "timings": {
+                    fmt(k): {"count": c, "totalSeconds": s}
+                    for k, (c, s) in self._timings.items()
+                },
+            }
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition (reference: /metrics)."""
+        lines = []
+        with self._lock:
+            def labels(k):
+                if not k[1]:
+                    return ""
+                inner = ",".join(f'{t}="{v}"' for t, v in k[1])
+                return "{" + inner + "}"
+
+            for k, v in sorted(self._counters.items()):
+                lines.append(f"# TYPE {self.prefix}_{k[0]} counter")
+                lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
+            for k, v in sorted(self._gauges.items()):
+                lines.append(f"# TYPE {self.prefix}_{k[0]} gauge")
+                lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
+            for k, (c, s) in sorted(self._timings.items()):
+                base = f"{self.prefix}_{k[0]}"
+                lines.append(f"# TYPE {base}_seconds summary")
+                lines.append(f"{base}_seconds_count{labels(k)} {c}")
+                lines.append(f"{base}_seconds_sum{labels(k)} {s}")
+        return "\n".join(lines) + "\n"
+
+
+class NopStats(StatsClient):
+    def count(self, *a, **k):
+        pass
+
+    def gauge(self, *a, **k):
+        pass
+
+    def timing(self, *a, **k):
+        pass

@@ -1,0 +1,68 @@
+"""Tracing: spans around executor calls, fragment ops, HTTP handlers.
+
+Reference: tracing/tracing.go (global Tracer, StartSpanFromContext) +
+tracing/opentracing adapter. OpenTracing/Jaeger isn't available here, so
+the Tracer records spans in-process (ring buffer) and can dump them for
+inspection; the API matches so an OTLP adapter can slot in later.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from contextlib import contextmanager
+
+MAX_SPANS = 4096
+
+
+class Span:
+    __slots__ = ("name", "start", "duration", "tags", "parent")
+
+    def __init__(self, name: str, parent: str | None = None):
+        self.name = name
+        self.parent = parent
+        self.start = time.time()
+        self.duration = 0.0
+        self.tags: dict = {}
+
+    def set_tag(self, k, v):
+        self.tags[k] = v
+
+    def to_json(self) -> dict:
+        return {
+            "name": self.name,
+            "parent": self.parent,
+            "start": self.start,
+            "durationSeconds": self.duration,
+            "tags": self.tags,
+        }
+
+
+class Tracer:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._spans: deque[Span] = deque(maxlen=MAX_SPANS)
+        self._local = threading.local()
+
+    @contextmanager
+    def span(self, name: str, **tags):
+        parent = getattr(self._local, "current", None)
+        s = Span(name, parent=parent.name if parent else None)
+        s.tags.update(tags)
+        self._local.current = s
+        t0 = time.perf_counter()
+        try:
+            yield s
+        finally:
+            s.duration = time.perf_counter() - t0
+            self._local.current = parent
+            with self._lock:
+                self._spans.append(s)
+
+    def recent(self, n: int = 100) -> list[dict]:
+        with self._lock:
+            return [s.to_json() for s in list(self._spans)[-n:]]
+
+
+GLOBAL_TRACER = Tracer()

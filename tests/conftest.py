@@ -9,12 +9,19 @@ Must set env vars BEFORE jax / pilosa_tpu are imported anywhere.
 import os
 
 os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Tests run on CPU with 8 virtual devices (multi-device sharding tests need
+# the virtual mesh). The box's sitecustomize registers a real-TPU PJRT
+# plugin and env JAX_PLATFORMS=axon; overriding the jax config before the
+# first backend initialization wins over both.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import numpy as np
 import pytest

@@ -278,3 +278,27 @@ def test_fragment_row_ids_small_shard_width(monkeypatch):
     frag.bitmap.add(1 * 4096 + 2)
     frag.bitmap.add(5 * 4096 + 3)
     assert frag.row_ids() == [0, 1, 5]
+
+
+def test_snapshot_version_enforced(rng):
+    data = bytearray(roaring.serialize(roaring.Bitmap.from_values(np.array([1], dtype=np.uint64))))
+    data[2] = 99  # clobber version
+    with pytest.raises(ValueError, match="version"):
+        roaring.deserialize(bytes(data))
+
+
+def test_bulk_import_empty_is_free(tmp_path):
+    frag = core.Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    frag.open()
+    before = frag.op_n
+    frag.bulk_import(np.empty(0, np.uint64), np.empty(0, np.uint64))
+    assert frag.op_n == before
+
+
+def test_rows_containing():
+    frag = core.Fragment(None, "i", "f", "standard", 0)
+    frag.open()
+    frag.set_bit(0, 42)
+    frag.set_bit(3, 42)
+    frag.set_bit(5, 41)
+    assert frag.rows_containing(42) == [0, 3]

@@ -285,3 +285,25 @@ def test_open_ended_time_range(env):
     assert set(r.columns().tolist()) == {1}
     (r,) = q(e, "Row(t=1, from=2018-06-02)")
     assert set(r.columns().tolist()) == {2}
+
+
+def test_agg_on_non_int_field_raises_execution_error(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=1)")
+    for bad in ["Sum(field=f)", "Min(field=f)", "Max(field=f)"]:
+        with pytest.raises(ExecutionError, match="not an int field"):
+            q(e, bad)
+
+
+def test_null_conditions(env):
+    h, idx, e = env
+    idx.create_field("v", FieldOptions(field_type="int"))
+    idx.create_field("f")
+    q(e, "Set(1, f=1) Set(2, f=1) Set(2, v=7)")
+    (r,) = q(e, "Row(v != null)")
+    assert r.columns().tolist() == [2]
+    (r,) = q(e, "Row(v == null)")
+    assert r.columns().tolist() == [1]
+    with pytest.raises(ExecutionError):
+        q(e, "Row(v > null)")

@@ -1,0 +1,146 @@
+"""HTTP API tests — the reference's route surface over a live server.
+
+Mirrors http/handler_test.go: real sockets, JSON bodies, error codes."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.config import Config
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "data"),
+                      anti_entropy_interval=0))
+    s.open()
+    yield s
+    s.close()
+
+
+def call(srv, method, path, body=None, raw=False):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or b"{}")
+
+
+def test_full_http_workflow(srv):
+    assert call(srv, "POST", "/index/i", {"options": {}}) == {"success": True}
+    assert call(srv, "POST", "/index/i/field/f", {"options": {}})["success"]
+    # writes via PQL
+    r = call(srv, "POST", "/index/i/query", b"Set(1, f=1) Set(3, f=1) Set(3, f=2)")
+    assert r["results"] == [True, True, True]
+    r = call(srv, "POST", "/index/i/query", b"Row(f=1)")
+    assert r["results"][0]["columns"] == [1, 3]
+    r = call(srv, "POST", "/index/i/query", b"Count(Intersect(Row(f=1), Row(f=2)))")
+    assert r["results"] == [1]
+    # schema
+    schema = call(srv, "GET", "/schema")
+    assert schema["indexes"][0]["name"] == "i"
+    assert schema["indexes"][0]["fields"][0]["name"] == "f"
+    idx = call(srv, "GET", "/index/i")
+    assert idx["name"] == "i"
+
+
+def test_import_endpoints(srv):
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    call(srv, "POST", "/index/i/field/v", {"options": {"type": "int"}})
+    call(
+        srv, "POST", "/index/i/field/f/import",
+        {"rowIDs": [1, 1, 2], "columnIDs": [10, 20, 10]},
+    )
+    call(
+        srv, "POST", "/index/i/field/v/import-value",
+        {"columnIDs": [10, 20], "values": [5, -3]},
+    )
+    r = call(srv, "POST", "/index/i/query", b"Count(Row(f=1))")
+    assert r["results"] == [2]
+    r = call(srv, "POST", "/index/i/query", b"Sum(field=v)")
+    assert r["results"] == [{"value": 2, "count": 2}]
+    # shards param
+    r = call(srv, "POST", "/index/i/query?shards=0", b"Count(Row(f=1))")
+    assert r["results"] == [2]
+
+
+def test_import_roaring_endpoint(srv):
+    import numpy as np
+
+    from pilosa_tpu import roaring
+
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    bm = roaring.Bitmap.from_values(np.array([5, 6, 7], dtype=np.uint64))  # row 0
+    call(srv, "POST", "/index/i/field/f/import-roaring/0", roaring.serialize(bm))
+    r = call(srv, "POST", "/index/i/query", b"Row(f=0)")
+    assert r["results"][0]["columns"] == [5, 6, 7]
+
+
+def test_export_csv(srv):
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    call(srv, "POST", "/index/i/query", b"Set(1, f=1) Set(2, f=3)")
+    csv = call(srv, "GET", "/export?index=i&field=f", raw=True).decode()
+    assert csv == "1,1\n3,2\n"
+
+
+def test_status_info_version_metrics(srv):
+    call(srv, "POST", "/index/i", {})
+    assert call(srv, "GET", "/status")["state"] == "NORMAL"
+    assert call(srv, "GET", "/info")["shardWidth"] > 0
+    assert "version" in call(srv, "GET", "/version")
+    call(srv, "POST", "/index/i/query", b"Count(Union())")
+    metrics = call(srv, "GET", "/metrics", raw=True).decode()
+    assert "pilosa_tpu_http_requests" in metrics
+    assert "query_seconds" in metrics
+    assert "spans" in call(srv, "GET", "/debug/traces")
+    assert "counters" in call(srv, "GET", "/debug/vars")
+
+
+def test_error_codes(srv):
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/ghost/query", b"Count(Row(f=1))")
+    assert e.value.code == 400
+    assert "not found" in json.loads(e.value.read())["error"]
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "GET", "/nope")
+    assert e.value.code == 404
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/i/query", b"Row(f=")
+    assert e.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/i/field/f/import", b"{bad json")
+    assert e.value.code == 400
+
+
+def test_delete_endpoints(srv):
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    assert call(srv, "DELETE", "/index/i/field/f")["success"]
+    assert call(srv, "DELETE", "/index/i")["success"]
+    with pytest.raises(urllib.error.HTTPError):
+        call(srv, "GET", "/index/i")
+
+
+def test_schema_apply_and_persistence(srv, tmp_path):
+    schema = {
+        "indexes": [
+            {
+                "name": "i2",
+                "options": {"keys": False},
+                "fields": [{"name": "g", "options": {"type": "int"}}],
+            }
+        ]
+    }
+    call(srv, "POST", "/schema", schema)
+    got = call(srv, "GET", "/schema")
+    assert got["indexes"][0]["name"] == "i2"
+    assert got["indexes"][0]["fields"][0]["options"]["type"] == "int"

@@ -130,3 +130,28 @@ def test_parse_errors():
 def test_repr_roundtrip_smoke():
     c = one("GroupBy(Rows(a), limit=10)")
     assert "GroupBy" in repr(c) and "Rows" in repr(c)
+
+
+def test_timestamp_condition_rejected():
+    with pytest.raises(PQLError):
+        pql.parse("Row(2020-01-01 < f < 2020-02-01)")
+
+
+def test_scientific_notation_floats():
+    assert one("TopN(f, threshold=1e20)").args["threshold"] == 1e20
+    assert one("TopN(f, threshold=1.5e-3)").args["threshold"] == 1.5e-3
+
+
+def test_to_pql_roundtrip():
+    for text in [
+        "Count(Intersect(Row(a=1), Row(b=2)))",
+        'Row(f="a b")',
+        "Row(5 <= age <= 10)",
+        "Row(age > -3)",
+        "GroupBy(Rows(a), limit=10, aggregate=Sum(field=v))",
+        "Set(10, t=1, 2016-01-01T00:00)",
+        "TopN(f, ids=[1, 2], x=true, y=null)",
+    ]:
+        c1 = one(text)
+        c2 = one(c1.to_pql())
+        assert c1 == c2, f"{text} -> {c1.to_pql()}"
