@@ -1,0 +1,134 @@
+// Native host bitmap kernels.
+//
+// The reference's host hot loops are compiled Go (roaring/roaring.go:
+// typed container ops + popcount helpers). This framework's host-side
+// equivalents — packed-word set ops, popcounts, position pack/unpack,
+// and ops-log batch application — live here as a small C++ library
+// loaded via ctypes (pilosa_tpu/native.py), with a numpy fallback when
+// the toolchain is unavailable. The TPU kernels in pilosa_tpu/ops remain
+// the primary compute path; this accelerates the CPU oracle, ingest
+// packing, and fragment load/replay.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -o libbitmap_kernels.so \
+//            bitmap_kernels.cpp
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// ------------------------------------------------------- elementwise ops
+void u32_and(const uint32_t* a, const uint32_t* b, uint32_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = a[i] & b[i];
+}
+
+void u32_or(const uint32_t* a, const uint32_t* b, uint32_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = a[i] | b[i];
+}
+
+void u32_xor(const uint32_t* a, const uint32_t* b, uint32_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = a[i] ^ b[i];
+}
+
+void u32_andnot(const uint32_t* a, const uint32_t* b, uint32_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) out[i] = a[i] & ~b[i];
+}
+
+// ------------------------------------------------------------- popcounts
+int64_t u32_popcount(const uint32_t* a, int64_t n) {
+    int64_t total = 0;
+    int64_t i = 0;
+    // 64-bit strides for throughput
+    const uint64_t* a64 = reinterpret_cast<const uint64_t*>(a);
+    int64_t n64 = n / 2;
+    for (int64_t j = 0; j < n64; ++j) total += __builtin_popcountll(a64[j]);
+    i = n64 * 2;
+    for (; i < n; ++i) total += __builtin_popcount(a[i]);
+    return total;
+}
+
+int64_t u32_and_popcount(const uint32_t* a, const uint32_t* b, int64_t n) {
+    int64_t total = 0;
+    const uint64_t* a64 = reinterpret_cast<const uint64_t*>(a);
+    const uint64_t* b64 = reinterpret_cast<const uint64_t*>(b);
+    int64_t n64 = n / 2;
+    for (int64_t j = 0; j < n64; ++j)
+        total += __builtin_popcountll(a64[j] & b64[j]);
+    for (int64_t i = n64 * 2; i < n; ++i)
+        total += __builtin_popcount(a[i] & b[i]);
+    return total;
+}
+
+// per-row masked popcount: matrix[rows, words] & filt[words] -> counts[rows]
+void u32_matrix_filter_counts(const uint32_t* matrix, const uint32_t* filt,
+                              int64_t rows, int64_t words, int64_t* counts) {
+    for (int64_t r = 0; r < rows; ++r) {
+        counts[r] = u32_and_popcount(matrix + r * words, filt, words);
+    }
+}
+
+// ------------------------------------------------------ pack / unpack
+// positions (int64, in [0, n_words*32)) -> packed words
+void pack_positions(const int64_t* positions, int64_t n_pos, uint32_t* words,
+                    int64_t n_words) {
+    std::memset(words, 0, n_words * sizeof(uint32_t));
+    for (int64_t i = 0; i < n_pos; ++i) {
+        int64_t p = positions[i];
+        words[p >> 5] |= (uint32_t(1) << (p & 31));
+    }
+}
+
+// packed words -> ascending positions; returns count written
+int64_t unpack_words(const uint32_t* words, int64_t n_words,
+                     int64_t* positions) {
+    int64_t k = 0;
+    for (int64_t w = 0; w < n_words; ++w) {
+        uint32_t bits = words[w];
+        int64_t base = w << 5;
+        while (bits) {
+            positions[k++] = base + __builtin_ctz(bits);
+            bits &= bits - 1;
+        }
+    }
+    return k;
+}
+
+// --------------------------------------------------- sorted u64 merges
+// all inputs sorted unique; outputs must have room (na+nb); return length
+int64_t u64_union(const uint64_t* a, int64_t na, const uint64_t* b, int64_t nb,
+                  uint64_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) out[k++] = b[j++];
+        else { out[k++] = a[i++]; ++j; }
+    }
+    while (i < na) out[k++] = a[i++];
+    while (j < nb) out[k++] = b[j++];
+    return k;
+}
+
+int64_t u64_intersect(const uint64_t* a, int64_t na, const uint64_t* b,
+                      int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) ++i;
+        else if (a[i] > b[j]) ++j;
+        else { out[k++] = a[i++]; ++j; }
+    }
+    return k;
+}
+
+int64_t u64_difference(const uint64_t* a, int64_t na, const uint64_t* b,
+                       int64_t nb, uint64_t* out) {
+    int64_t i = 0, j = 0, k = 0;
+    while (i < na && j < nb) {
+        if (a[i] < b[j]) out[k++] = a[i++];
+        else if (a[i] > b[j]) ++j;
+        else { ++i; ++j; }
+    }
+    while (i < na) out[k++] = a[i++];
+    return k;
+}
+
+}  // extern "C"

@@ -21,10 +21,13 @@ a per-fragment lock only around bitmap/ops-log updates.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import os
 import threading
 
 import numpy as np
+
+_FRAGMENT_UIDS = itertools.count(1)
 
 from pilosa_tpu import roaring
 from pilosa_tpu.core.cache import NopCache, make_cache
@@ -69,6 +72,11 @@ class Fragment:
         self._dirty_rows: set[int] = set()
         self._all_dirty = True
         self._device = None
+        # monotone mutation counter; stacked-matrix caches key off
+        # (uid, version) so a deleted-and-recreated fragment never
+        # aliases a cache entry
+        self.version = 0
+        self.uid = next(_FRAGMENT_UIDS)
 
     # ----------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -262,11 +270,13 @@ class Fragment:
             self.snapshot()
             self._all_dirty = True
             self._device = None
+            self.version += 1
             self._rebuild_cache()
 
     def _mark_dirty(self, row: int) -> None:
         self._dirty_rows.add(row)
         self._device = None
+        self.version += 1
 
     def _rebuild_cache(self) -> None:
         self.cache.clear()
@@ -276,14 +286,11 @@ class Fragment:
             self.cache.add(r, self.row_count(r))
 
     # ----------------------------------------------------------- device
-    def device_matrix(self):
-        """(jax uint32[R_pad, W], n_rows) — packed matrix on device.
-
-        Dirty rows are repacked host-side incrementally; the device upload
-        happens only when something changed since the last query.
-        """
-        import jax.numpy as jnp  # deferred: keep host paths importable fast
-
+    def host_matrix(self) -> tuple[np.ndarray, int]:
+        """(np uint32[R_pad, W], n_rows) — packed matrix on host, with
+        dirty rows repacked incrementally. The stacked-query path reads
+        this directly (one upload for the whole stack) instead of paying
+        a per-fragment device round trip."""
         with self._lock:
             n = max(self.n_rows(), 1)
             r_pad = _pad_rows(n)
@@ -305,8 +312,17 @@ class Fragment:
                         self._np_matrix[r] = self.row_packed(r)
                 self._dirty_rows.clear()
                 self._device = None
+            return self._np_matrix, n
+
+    def device_matrix(self):
+        """(jax uint32[R_pad, W], n_rows) — packed matrix on device;
+        uploaded only when something changed since the last call."""
+        import jax.numpy as jnp  # deferred: keep host paths importable fast
+
+        with self._lock:
+            m, n = self.host_matrix()
             if self._device is None:
-                self._device = jnp.asarray(self._np_matrix)
+                self._device = jnp.asarray(m)
             return self._device, n
 
     # ------------------------------------------------------ anti-entropy
@@ -355,4 +371,5 @@ class Fragment:
             self.snapshot()
             self._all_dirty = True
             self._device = None
+            self.version += 1
             self._rebuild_cache()

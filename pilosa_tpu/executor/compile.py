@@ -1,0 +1,410 @@
+"""Query compilation: PQL call tree → ONE jitted device program.
+
+Reference: executor.go walks the AST per shard with Go hot loops and
+reduces over HTTP. Here the whole read query becomes a single XLA
+program over *stacked* field arrays:
+
+- each (field, view) keeps a device-resident stacked matrix
+  ``uint32[S, R, W]`` (S = shards, R = padded rows) rebuilt only when a
+  fragment version changes — uploads are amortized across queries;
+- a call tree compiles to a closure over (matrix, row_id) leaf inputs;
+  row IDs are traced scalars, so one compiled program serves every row
+  of the same query shape (Count(Intersect(Row, Row)) compiles once);
+- a shard mask input restricts execution to a query's shard subset
+  without recompiling;
+- the reduction (Count/Sum/TopN) happens inside the same program, so a
+  query is one host→device dispatch and one scalar readback.
+
+The structural cache key is the call tree's shape with row IDs
+abstracted out; jax.jit's own shape cache handles S/R/W changes.
+"""
+
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu import ops
+from pilosa_tpu.core import (
+    BSI_OFFSET,
+    EXISTENCE_FIELD,
+    FIELD_INT,
+    FIELD_TIME,
+    VIEW_BSI,
+    VIEW_STANDARD,
+    Field,
+    Index,
+)
+from pilosa_tpu.core.timequantum import views_by_time_range
+from pilosa_tpu.pql import Call, Condition
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+
+class PlanError(ValueError):
+    pass
+
+
+# --------------------------------------------------------------- stacking
+def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
+    """Stack a view's fragment host matrices → (np uint32[S, R, W], R).
+
+    Shared by the query compiler's StackCache and the mesh engine
+    (parallel/mesh.py). Reads fragment HOST matrices — no per-fragment
+    device round trips; the caller does one upload for the whole stack.
+    """
+    mats, max_rows = [], 1
+    for s in shards:
+        frag = view.fragment(s) if view else None
+        if frag is None:
+            mats.append(None)
+        else:
+            m, _n = frag.host_matrix()
+            mats.append(m)
+            max_rows = max(max_rows, m.shape[0])
+    stacked = np.zeros((len(shards), max_rows, WORDS_PER_SHARD), dtype=np.uint32)
+    for i, m in enumerate(mats):
+        if m is not None:
+            stacked[i, : m.shape[0]] = m
+    return stacked, max_rows
+
+
+class StackCache:
+    """Device-resident stacked (field, view) matrices.
+
+    Entries key on the exact shard list and invalidate via per-fragment
+    (uid, version) tokens — a deleted-and-recreated index gets fresh
+    fragment uids, so stale data can never be served. An LRU cap bounds
+    device memory when workloads query many distinct shard subsets.
+    """
+
+    MAX_ENTRIES = 64
+
+    def __init__(self):
+        from collections import OrderedDict
+
+        self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+
+    def matrix(self, idx: Index, field: Field, view_name: str, shards: list[int]):
+        """(jnp uint32[S, R, W], n_rows int) for the given shard list."""
+        view = field.view(view_name)
+        key = (idx.name, field.name, view_name, tuple(shards))
+        versions = tuple(self._frag_token(view, s) for s in shards)
+        cached = self._cache.get(key)
+        if cached is not None and cached[0] == versions:
+            self._cache.move_to_end(key)
+            return cached[1], cached[2]
+        stacked, max_rows = stack_view_matrices(view, shards)
+        dev = jnp.asarray(stacked)
+        self._cache[key] = (versions, dev, max_rows)
+        self._cache.move_to_end(key)
+        while len(self._cache) > self.MAX_ENTRIES:
+            self._cache.popitem(last=False)
+        return dev, max_rows
+
+    @staticmethod
+    def _frag_token(view, shard: int) -> tuple:
+        frag = view.fragment(shard) if view else None
+        return (-1, -1) if frag is None else (frag.uid, frag.version)
+
+    def invalidate(self) -> None:
+        self._cache.clear()
+
+
+# ------------------------------------------------------------------ plans
+class _Planner:
+    """Builds (closure, leaf inputs, structure key) for one call tree."""
+
+    def __init__(self, idx: Index, shards: list[int], stacks: StackCache):
+        self.idx = idx
+        self.shards = shards
+        self.stacks = stacks
+        self.arrays: list[Any] = []  # device inputs (stacked matrices)
+        self.scalars: list[int] = []  # traced row-id inputs
+        self._array_keys: dict[tuple, int] = {}
+
+    def _add_array(self, key: tuple, build: Callable[[], Any]) -> int:
+        i = self._array_keys.get(key)
+        if i is None:
+            i = len(self.arrays)
+            self._array_keys[key] = i
+            self.arrays.append(build())
+        return i
+
+    def _add_scalar(self, value: int) -> int:
+        self.scalars.append(int(value))
+        return len(self.scalars) - 1
+
+    def _matrix_leaf(self, field: Field, view_name: str, row_id: int):
+        """closure(arrays, scalars) → uint32[S, W] for one stored row."""
+        ai = self._add_array(
+            ("m", field.name, view_name),
+            lambda: self.stacks.matrix(self.idx, field, view_name, self.shards)[0],
+        )
+        si = self._add_scalar(row_id)
+
+        def run(arrays, scalars):
+            m = arrays[ai]
+            row = scalars[si]
+            # out-of-range / -1 rows read as zeros
+            return jnp.take(m, row, axis=1, mode="fill", fill_value=0)
+
+        return run, f"row(m:{field.name}/{view_name})"
+
+    def _existence(self):
+        ef = self.idx.field(EXISTENCE_FIELD)
+        if not self.idx.options.track_existence:
+            raise PlanError(
+                "query requires existence tracking (index created with "
+                "track_existence=false)"
+            )
+        if ef is None:
+            return (lambda arrays, scalars: jnp.zeros(
+                (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+            )), "exists(empty)"
+        return self._matrix_leaf(ef, VIEW_STANDARD, 0)
+
+    def _bsi(self, field: Field):
+        """closure → uint32[S, D, W] bit-slice block."""
+        ai = self._add_array(
+            ("bsi", field.name),
+            lambda: self.stacks.matrix(self.idx, field, VIEW_BSI, self.shards)[0],
+        )
+        need = BSI_OFFSET + field.bit_depth
+
+        def run(arrays, scalars):
+            m = arrays[ai]
+            if m.shape[1] < need:
+                m = jnp.pad(m, ((0, 0), (0, need - m.shape[1]), (0, 0)))
+            return m[:, :need]
+
+        return run, f"bsi({field.name}:{field.bit_depth})"
+
+    # ---------------------------------------------------------- call tree
+    def plan(self, call: Call):
+        """→ (closure(arrays, scalars) → uint32[S, W], structure key)"""
+        name = call.name
+        if name in ("Row", "Range"):
+            return self._plan_row(call)
+        if name in ("Union", "Intersect", "Difference", "Xor"):
+            subs = [self.plan(ch) for ch in call.children]
+            if not subs:
+                if name == "Intersect":
+                    raise PlanError("Intersect() needs at least one child")
+                zero = lambda arrays, scalars: jnp.zeros(
+                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                )
+                return zero, f"{name}()"
+            fns = [s[0] for s in subs]
+            keys = ",".join(s[1] for s in subs)
+            op = {
+                "Union": jnp.bitwise_or,
+                "Intersect": jnp.bitwise_and,
+                "Xor": jnp.bitwise_xor,
+                "Difference": lambda a, b: a & ~b,
+            }[name]
+
+            def run(arrays, scalars):
+                out = fns[0](arrays, scalars)
+                for fn in fns[1:]:
+                    out = op(out, fn(arrays, scalars))
+                return out
+
+            return run, f"{name}({keys})"
+        if name == "Not":
+            if len(call.children) != 1:
+                raise PlanError("Not() takes exactly one call")
+            sub, key = self.plan(call.children[0])
+            ex, exkey = self._existence()
+            return (
+                lambda arrays, scalars: ex(arrays, scalars) & ~sub(arrays, scalars)
+            ), f"Not({key},{exkey})"
+        if name == "All":
+            ex, exkey = self._existence()
+            return ex, f"All({exkey})"
+        if name == "Shift":
+            if len(call.children) != 1:
+                raise PlanError("Shift() takes exactly one call")
+            n = call.arg("n", 1)
+            if not isinstance(n, int) or n < 0:
+                raise PlanError(f"Shift() n must be a non-negative integer, got {n!r}")
+            sub, key = self.plan(call.children[0])
+            return (
+                lambda arrays, scalars: ops.shift_words(sub(arrays, scalars), n)
+            ), f"Shift{n}({key})"
+        raise PlanError(f"{name!r} is not a bitmap call")
+
+    def _plan_row(self, call: Call):
+        cond = call.condition()
+        if cond is not None:
+            return self._plan_condition(call, cond)
+        fa = call.field_arg()
+        if fa is None:
+            raise PlanError(f"Row() needs a field argument: {call!r}")
+        fname, row = fa
+        field = self.idx.field(fname)
+        if field is None:
+            raise PlanError(f"field {fname!r} not found")
+        row_id = self._row_id(field, row)
+
+        ts_from, ts_to = call.arg("from"), call.arg("to")
+        if ts_from is not None or ts_to is not None:
+            if field.options.field_type != FIELD_TIME:
+                raise PlanError(f"field {fname!r} is not a time field")
+            bounds = field.time_bounds()
+            if bounds is None:
+                zero = lambda arrays, scalars: jnp.zeros(
+                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                )
+                return zero, "time(empty)"
+            ts_from = ts_from if ts_from is not None else bounds[0]
+            ts_to = ts_to if ts_to is not None else bounds[1]
+            view_names = [
+                v
+                for v in views_by_time_range(
+                    VIEW_STANDARD, ts_from, ts_to, field.options.time_quantum
+                )
+                if field.view(v) is not None
+            ]
+            subs = [self._matrix_leaf(field, v, row_id) for v in view_names]
+            if not subs:
+                zero = lambda arrays, scalars: jnp.zeros(
+                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                )
+                return zero, "time(empty)"
+            fns = [s[0] for s in subs]
+            keys = ",".join(s[1] for s in subs)
+
+            def run(arrays, scalars):
+                out = fns[0](arrays, scalars)
+                for fn in fns[1:]:
+                    out = out | fn(arrays, scalars)
+                return out
+
+            return run, f"timeunion({keys})"
+        return self._matrix_leaf(field, VIEW_STANDARD, row_id)
+
+    def _plan_condition(self, call: Call, cond: tuple[str, Condition]):
+        fname, condition = cond
+        field = self.idx.field(fname)
+        if field is None:
+            raise PlanError(f"field {fname!r} not found")
+        if field.options.field_type != FIELD_INT:
+            raise PlanError(f"field {fname!r} is not an int field")
+        bsi, bkey = self._bsi(field)
+        ex, _ = self._existence() if condition.value is None and condition.op == "==" else (None, None)
+
+        value = condition.value
+        op = condition.op
+        if value is None:
+            if op == "!=":
+                return (
+                    lambda arrays, scalars: bsi(arrays, scalars)[:, 0]
+                ), f"notnull({bkey})"
+            if op == "==":
+                return (
+                    lambda arrays, scalars: ex(arrays, scalars)
+                    & ~bsi(arrays, scalars)[:, 0]
+                ), f"isnull({bkey})"
+            raise PlanError(f"null only supports ==/!= comparisons, got {op!r}")
+
+        vmapped_between = jax.vmap(ops.bsi.between, in_axes=(0, None, None))
+        vmapped_cmp = jax.vmap(ops.bsi.compare, in_axes=(0, None, None))
+        if op == "between":
+            lo, hi = int(value[0]), int(value[1])
+            return (
+                lambda arrays, scalars: vmapped_between(bsi(arrays, scalars), lo, hi)
+            ), f"between[{lo},{hi}]({bkey})"
+        v = int(value)
+        return (
+            lambda arrays, scalars: vmapped_cmp(bsi(arrays, scalars), op, v)
+        ), f"cmp[{op}{v}]({bkey})"
+
+    def _row_id(self, field: Field, row: Any) -> int:
+        if isinstance(row, bool):
+            return int(row)
+        if isinstance(row, int):
+            return row
+        if isinstance(row, str):
+            if not field.options.keys:
+                raise PlanError(f"field {field.name!r} does not use string keys")
+            rid = field.row_keys.translate_key(row, create=False)
+            return rid if rid is not None else -1
+        raise PlanError(f"bad row value {row!r}")
+
+
+# ----------------------------------------------------------- compiled API
+class QueryCompiler:
+    """Caches jitted programs keyed by (index, structure, mode).
+
+    The stacked arrays are built for the exact shard list of each query
+    (the stack cache keys on it), so programs need no shard mask — two
+    different shard subsets of the same length share one compiled program
+    and differ only in their inputs.
+    """
+
+    def __init__(self):
+        self.stacks = StackCache()
+        self._programs: dict[tuple, Callable] = {}
+        self._ones: dict[int, Any] = {}
+
+    def program(self, key: tuple, build: Callable[[], Callable]) -> Callable:
+        """Generic compiled-program cache (used by the executor for its
+        aggregate programs as well)."""
+        prog = self._programs.get(key)
+        if prog is None:
+            prog = build()
+            self._programs[key] = prog
+        return prog
+
+    def ones(self, n_shards: int):
+        """Cached all-ones filter [S, W] on device."""
+        cached = self._ones.get(n_shards)
+        if cached is None:
+            cached = jnp.full(
+                (n_shards, WORDS_PER_SHARD), 0xFFFFFFFF, dtype=jnp.uint32
+            )
+            self._ones[n_shards] = cached
+        return cached
+
+    def _plan(self, idx: Index, call: Call, shards: list[int]):
+        planner = _Planner(idx, shards, self.stacks)
+        run, skey = planner.plan(call)
+        return planner, run, skey
+
+    def bitmap_device(self, idx: Index, call: Call, shards: list[int]):
+        """Evaluate a bitmap call for all shards in one program →
+        device uint32[S, W]."""
+        planner, run, skey = self._plan(idx, call, shards)
+        key = (idx.name, len(shards), skey, "words")
+        prog = self.program(
+            key, lambda: jax.jit(lambda arrays, scalars: run(arrays, scalars))
+        )
+        return prog(planner.arrays, jnp.asarray(planner.scalars, jnp.int32))
+
+    def bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
+        return np.asarray(self.bitmap_device(idx, call, shards))
+
+    def count_async(self, idx: Index, call: Call, shards: list[int]):
+        """Device int64 scalar (not synced) — lets callers pipeline many
+        queries before paying the device→host readback latency."""
+        planner, run, skey = self._plan(idx, call, shards)
+        key = (idx.name, len(shards), skey, "count")
+
+        def build():
+            @jax.jit
+            def prog(arrays, scalars):
+                words = run(arrays, scalars)
+                return jnp.sum(ops.popcount_rows(words).astype(jnp.int64))
+
+            return prog
+
+        prog = self.program(key, build)
+        return prog(planner.arrays, jnp.asarray(planner.scalars, jnp.int32))
+
+    def count(self, idx: Index, call: Call, shards: list[int]) -> int:
+        return int(self.count_async(idx, call, shards))

@@ -1,22 +1,22 @@
-"""Query executor: PQL AST → device programs over sharded fragments.
+"""Query executor: PQL AST → compiled device programs over sharded
+fragments.
 
 Reference: executor.go (executor.Execute, executeCall, executeBitmapCall,
-executeCount, executeTopN, executeSum/Min/Max, executeGroupBy, executeRows,
-executeSet/Clear…, mapReduce, mapperLocal/mapperRemote). Redesigned for
-TPU:
+executeCount, executeTopN, executeSum/Min/Max, executeGroupBy,
+executeRows, executeSet/Clear…, mapReduce, mapperLocal/mapperRemote).
+Redesigned for TPU:
 
-- a bitmap expression evaluates per shard as a chain of elementwise bitwise
-  ops over the fragment's dense packed matrix — XLA fuses the chain into a
-  single kernel; counts are fused op+popcount reductions;
-- the reference's HTTP scatter-gather reduce (mapReduce → mapperRemote)
-  becomes, on a single host, a loop over resident shards; the cluster layer
-  fans out non-local shards (see pilosa_tpu.parallel / server), and the
-  mesh path executes all shards in one pjit program with psum reductions;
-- TopN is EXACT in one pass (per-row masked popcount over the resident
-  matrix + top_k) instead of the reference's approximate cache-fed phase 1;
-  the two-phase recount survives only for the ids= form. This is a
-  deliberate departure: the rank cache exists because the reference cannot
-  afford full row scans per query; the dense device matrix can.
+- every read query executes as ONE jitted program over stacked
+  ``uint32[S, R, W]`` field arrays (see executor/compile.py) — the
+  reference's per-shard goroutine fan-out and HTTP reduce collapse into a
+  single XLA dispatch with on-device reductions;
+- aggregates (Count/Sum/Min/Max/TopN) reduce on device; only scalars (or
+  a [rows] count vector for TopN) cross back to the host;
+- TopN is EXACT in one pass (per-row masked popcount + sort) instead of
+  the reference's approximate cache-fed phase 1; the two-phase recount
+  survives only for the ids= form;
+- the cluster layer (pilosa_tpu.parallel) fans out non-local shards and
+  reduces typed partials; this executor always runs the local portion.
 """
 
 from __future__ import annotations
@@ -26,25 +26,24 @@ from typing import Any
 
 import numpy as np
 
+import jax
+import jax.numpy as jnp
+
 from pilosa_tpu import ops
 from pilosa_tpu.core import (
     BSI_OFFSET,
-    EXISTENCE_FIELD,
-    FIELD_BOOL,
     FIELD_INT,
-    FIELD_MUTEX,
-    FIELD_TIME,
     VIEW_BSI,
     VIEW_STANDARD,
     Field,
     Holder,
     Index,
 )
-from pilosa_tpu.core.timequantum import views_by_time_range
+from pilosa_tpu.executor.compile import PlanError, QueryCompiler
 from pilosa_tpu.executor.row import RowResult
-from pilosa_tpu.pql import Call, Condition, PQLError, parse
+from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.roaring import unpack_words
-from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 BITMAP_CALLS = {
     "Row",
@@ -81,6 +80,7 @@ class SumCount(dict):
 class Executor:
     def __init__(self, holder: Holder):
         self.holder = holder
+        self.compiler = QueryCompiler()
 
     # ------------------------------------------------------------ entry
     def execute(
@@ -111,26 +111,39 @@ class Executor:
         if name in WRITE_CALLS:
             return self._execute_write(idx, call)
         shard_list = self._shards(idx, shards)
-        if name in BITMAP_CALLS:
-            segs = {s: self._bitmap(idx, call, s) for s in shard_list}
-            res = RowResult(segs)
-            self._attach_keys(idx, res)
-            return res
-        if name == "Count":
-            return self._execute_count(idx, call, shard_list)
-        if name == "Sum":
-            return self._execute_sum(idx, call, shard_list)
-        if name in ("Min", "Max"):
-            return self._execute_min_max(idx, call, shard_list, name == "Max")
-        if name == "TopN":
-            return self._execute_topn(idx, call, shard_list)
-        if name == "Rows":
-            return self._execute_rows(idx, call, shard_list)
-        if name == "GroupBy":
-            return self._execute_group_by(idx, call, shard_list)
+        try:
+            if name in BITMAP_CALLS:
+                words = self._bitmap_words(idx, call, shard_list)
+                res = RowResult(
+                    {s: words[i] for i, s in enumerate(shard_list)}
+                )
+                self._attach_keys(idx, res)
+                return res
+            if name == "Count":
+                if len(call.children) != 1:
+                    raise ExecutionError("Count() takes exactly one call")
+                return self.compiler.count(idx, call.children[0], shard_list)
+            if name == "Sum":
+                return self._execute_sum(idx, call, shard_list)
+            if name in ("Min", "Max"):
+                return self._execute_min_max(idx, call, shard_list, name == "Max")
+            if name == "TopN":
+                return self._execute_topn(idx, call, shard_list)
+            if name == "Rows":
+                return self._execute_rows(idx, call, shard_list)
+            if name == "GroupBy":
+                return self._execute_group_by(idx, call, shard_list)
+        except PlanError as e:
+            raise ExecutionError(str(e)) from e
         raise ExecutionError(f"unknown call {name!r}")
 
     # ----------------------------------------------------------- helpers
+    def _bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
+        try:
+            return self.compiler.bitmap_words(idx, call, shards)
+        except PlanError as e:
+            raise ExecutionError(str(e)) from e
+
     def _field(self, idx: Index, name: str) -> Field:
         f = idx.field(name)
         if f is None:
@@ -138,7 +151,6 @@ class Executor:
         return f
 
     def _row_id(self, field: Field, row: Any, create: bool = False) -> int | None:
-        """Resolve a row arg (int or string key) to a row ID."""
         if isinstance(row, bool):
             return int(row)
         if isinstance(row, int):
@@ -165,14 +177,7 @@ class Executor:
             cols = res.columns().tolist()
             res.keys = [idx.column_keys.translate_id(c) or str(c) for c in cols]
 
-    def _zeros(self):
-        return np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
-
-    def _ones(self):
-        return np.full(WORDS_PER_SHARD, 0xFFFFFFFF, dtype=np.uint32)
-
     def _call_field_name(self, call: Call) -> str:
-        """field= arg or first positional (TopN/Rows/Sum style calls)."""
         fname = call.arg("field")
         if fname is None and call.pos_args:
             fname = call.pos_args[0]
@@ -180,193 +185,69 @@ class Executor:
             raise ExecutionError(f"{call.name}() needs a field argument")
         return fname
 
-    def _frag_row_words(self, field: Field, view_name: str, shard: int, row: int):
-        view = field.view(view_name)
-        frag = view.fragment(shard) if view else None
-        if frag is None:
-            return self._zeros()
-        m, n = frag.device_matrix()
-        if row < 0 or row >= n:
-            return self._zeros()
-        return m[row]
-
-    def _bsi_slices(self, field: Field, shard: int):
-        """(slices uint32[2+depth, W]) for an int field's shard, or None."""
-        view = field.view(VIEW_BSI)
-        frag = view.fragment(shard) if view else None
-        if frag is None:
-            return None
-        m, _n = frag.device_matrix()
-        depth = field.bit_depth
-        need = BSI_OFFSET + depth
-        if m.shape[0] < need:
-            pad = np.zeros((need - m.shape[0], m.shape[1]), dtype=np.uint32)
-            m = np.concatenate([np.asarray(m), pad], axis=0)
-        return m[:need]
-
-    def _existence_words(self, idx: Index, shard: int):
-        if not idx.options.track_existence:
-            raise ExecutionError(
-                "query requires existence tracking (index created with "
-                "track_existence=false)"
-            )
-        ef = idx.field(EXISTENCE_FIELD)
-        if ef is None:
-            return self._zeros()
-        return self._frag_row_words(ef, VIEW_STANDARD, shard, 0)
-
-    # ------------------------------------------------------- bitmap eval
-    def _bitmap(self, idx: Index, call: Call, shard: int):
-        """Evaluate a bitmap call for one shard → uint32[W] (device)."""
-        name = call.name
-        if name in ("Row", "Range"):
-            return self._bitmap_row(idx, call, shard)
-        if name == "Union":
-            out = self._zeros()
-            for ch in call.children:
-                out = ops.w_or(out, self._bitmap(idx, ch, shard))
-            return out
-        if name == "Intersect":
-            if not call.children:
-                raise ExecutionError("Intersect() needs at least one child")
-            out = self._bitmap(idx, call.children[0], shard)
-            for ch in call.children[1:]:
-                out = ops.w_and(out, self._bitmap(idx, ch, shard))
-            return out
-        if name == "Difference":
-            if not call.children:
-                raise ExecutionError("Difference() needs at least one child")
-            out = self._bitmap(idx, call.children[0], shard)
-            for ch in call.children[1:]:
-                out = ops.w_andnot(out, self._bitmap(idx, ch, shard))
-            return out
-        if name == "Xor":
-            out = self._zeros()
-            for ch in call.children:
-                out = ops.w_xor(out, self._bitmap(idx, ch, shard))
-            return out
-        if name == "Not":
-            if len(call.children) != 1:
-                raise ExecutionError("Not() takes exactly one call")
-            exists = self._existence_words(idx, shard)
-            return ops.w_andnot(exists, self._bitmap(idx, call.children[0], shard))
-        if name == "All":
-            return self._existence_words(idx, shard)
-        if name == "Shift":
-            if len(call.children) != 1:
-                raise ExecutionError("Shift() takes exactly one call")
-            n = call.arg("n", 1)
-            if not isinstance(n, int) or n < 0:
-                raise ExecutionError(f"Shift() n must be a non-negative integer, got {n!r}")
-            # per-shard shift: bits crossing the shard boundary are dropped
-            # (same per-shard behavior as the reference's Shift)
-            return ops.shift_words(self._bitmap(idx, call.children[0], shard), n)
-        raise ExecutionError(f"{name!r} is not a bitmap call")
-
-    def _bitmap_row(self, idx: Index, call: Call, shard: int):
-        cond = call.condition()
-        if cond is not None:
-            fname, condition = cond
-            field = self._field(idx, fname)
-            if field.options.field_type != FIELD_INT:
-                raise ExecutionError(f"field {fname!r} is not an int field")
-            slices = self._bsi_slices(field, shard)
-            if slices is None:
-                if condition.op == "==" and condition.value is None:
-                    return self._existence_words(idx, shard)
-                return self._zeros()
-            if condition.value is None:
-                # null comparisons: f != null ⇒ has a value;
-                # f == null ⇒ exists in the index but has no value
-                exists = slices[0]
-                if condition.op == "!=":
-                    return exists
-                if condition.op == "==":
-                    return ops.w_andnot(self._existence_words(idx, shard), exists)
-                raise ExecutionError(
-                    f"null only supports ==/!= comparisons, got {condition.op!r}"
-                )
-            if condition.op == "between":
-                lo, hi = condition.value
-                return ops.bsi.between(slices, int(lo), int(hi))
-            return ops.bsi.compare(slices, condition.op, int(condition.value))
-
-        fa = call.field_arg()
-        if fa is None:
-            raise ExecutionError(f"Row() needs a field argument: {call!r}")
-        fname, row = fa
-        field = self._field(idx, fname)
-        row_id = self._row_id(field, row)
-        if row_id is None:
-            return self._zeros()
-
-        ts_from, ts_to = call.arg("from"), call.arg("to")
-        if ts_from is not None or ts_to is not None:
-            if field.options.field_type != FIELD_TIME:
-                raise ExecutionError(f"field {fname!r} is not a time field")
-            # bound open endpoints by the materialized buckets so a
-            # fine-grained quantum never enumerates empty calendar views
-            bounds = field.time_bounds()
-            if bounds is None:
-                return self._zeros()
-            ts_from = ts_from if ts_from is not None else bounds[0]
-            ts_to = ts_to if ts_to is not None else bounds[1]
-            out = self._zeros()
-            for view_name in views_by_time_range(
-                VIEW_STANDARD, ts_from, ts_to, field.options.time_quantum
-            ):
-                out = ops.w_or(
-                    out, self._frag_row_words(field, view_name, shard, row_id)
-                )
-            return out
-        return self._frag_row_words(field, VIEW_STANDARD, shard, row_id)
-
-    # ------------------------------------------------------- aggregates
-    def _execute_count(self, idx: Index, call: Call, shards: list[int]) -> int:
-        if len(call.children) != 1:
-            raise ExecutionError("Count() takes exactly one call")
-        total = 0
-        for s in shards:
-            total += int(ops.popcount(self._bitmap(idx, call.children[0], s)))
-        return total
-
-    def _filter_words(self, idx: Index, call: Call, shard: int):
-        """Child-call filter for aggregates; all-ones when absent."""
-        if call.children:
-            return self._bitmap(idx, call.children[0], shard)
-        return self._ones()
-
     def _agg_field(self, idx: Index, call: Call) -> Field:
         field = self._field(idx, self._call_field_name(call))
         if field.options.field_type != FIELD_INT:
             raise ExecutionError(f"field {field.name!r} is not an int field")
         return field
 
+    def _filter_device(self, idx: Index, call: Call, shards: list[int]):
+        """Child-call filter as a device array [S, W]; all-ones when
+        absent (cached per shard count)."""
+        if call.children:
+            try:
+                return self.compiler.bitmap_device(idx, call.children[0], shards)
+            except PlanError as e:
+                raise ExecutionError(str(e)) from e
+        return self.compiler.ones(len(shards))
+
+    def _bsi_stacked(self, idx: Index, field: Field, shards: list[int]):
+        """uint32[S, D, W] bit-slice block for an int field (device)."""
+        m, _rows = self.compiler.stacks.matrix(idx, field, VIEW_BSI, shards)
+        need = BSI_OFFSET + field.bit_depth
+        if m.shape[1] < need:
+            m = jnp.pad(m, ((0, 0), (0, need - m.shape[1]), (0, 0)))
+        return m[:, :need]
+
+    # ------------------------------------------------------- aggregates
+    def _sum_program(self, field: Field, n_shards: int):
+        """Compiled vmapped BSI sum over stacked slices; shared by Sum and
+        GroupBy's aggregate."""
+        return self.compiler.program(
+            ("sum", n_shards, field.bit_depth),
+            lambda: jax.jit(
+                lambda s, f: tuple(
+                    x.astype(jnp.int64).sum(axis=0)
+                    for x in jax.vmap(ops.bsi.sum_counts)(s, f)
+                )
+            ),
+        )
+
     def _execute_sum(self, idx: Index, call: Call, shards: list[int]) -> SumCount:
         field = self._agg_field(idx, call)
-        total, n_total = 0, 0
-        for s in shards:
-            slices = self._bsi_slices(field, s)
-            if slices is None:
-                continue
-            filt = self._filter_words(idx, call, s)
-            pos, neg, n = ops.bsi.sum_counts(slices, filt)
-            total += ops.bsi.weigh_sum(np.asarray(pos), np.asarray(neg))
-            n_total += int(n)
-        return SumCount(total, n_total)
+        slices = self._bsi_stacked(idx, field, shards)
+        filt = self._filter_device(idx, call, shards)
+        pos, neg, n = self._sum_program(field, len(shards))(slices, filt)
+        total = ops.bsi.weigh_sum(np.asarray(pos), np.asarray(neg))
+        return SumCount(total, int(n))
 
     def _execute_min_max(
         self, idx: Index, call: Call, shards: list[int], want_max: bool
     ) -> SumCount:
         field = self._agg_field(idx, call)
+        slices = self._bsi_stacked(idx, field, shards)
+        filt = self._filter_device(idx, call, shards)
+        prog = self.compiler.program(
+            ("minmax", len(shards), field.bit_depth, want_max),
+            lambda: jax.jit(
+                lambda s, f: jax.vmap(
+                    lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max)
+                )(s, f)
+            ),
+        )
+        values, counts = (np.asarray(x) for x in prog(slices, filt))
         best, best_count = None, 0
-        for s in shards:
-            slices = self._bsi_slices(field, s)
-            if slices is None:
-                continue
-            filt = self._filter_words(idx, call, s)
-            v, n = ops.bsi.min_max(slices, filt, want_max=want_max)
-            v, n = int(v), int(n)
+        for v, n in zip(values.tolist(), counts.tolist()):
             if n == 0:
                 continue
             if best is None or (v > best if want_max else v < best):
@@ -384,37 +265,45 @@ class Executor:
         if attr_name is not None and not attr_values:
             raise ExecutionError("TopN() attrName requires attrValues")
 
-        # per-shard filtered counts over ALL rows, summed across shards —
-        # exact in one pass (see module docstring)
-        counts_by_row: dict[int, int] = {}
-        for s in shards:
-            view = field.view(VIEW_STANDARD)
-            frag = view.fragment(s) if view else None
-            if frag is None:
-                continue
-            m, n_rows = frag.device_matrix()
-            filt = self._filter_words(idx, call, s)
-            if ids is not None:
-                row_ids = np.asarray(ids, dtype=np.int32)
-                shard_counts = np.asarray(
-                    ops.topn.candidate_counts(np.asarray(m), row_ids, filt)
-                )
-                for rid, c in zip(row_ids.tolist(), shard_counts.tolist()):
-                    counts_by_row[rid] = counts_by_row.get(rid, 0) + int(c)
-            else:
-                shard_counts = np.asarray(ops.matrix_filter_counts(m, filt))[:n_rows]
-                for rid in np.flatnonzero(shard_counts).tolist():
-                    counts_by_row[rid] = counts_by_row.get(rid, 0) + int(
-                        shard_counts[rid]
-                    )
-
-        pairs = [(rid, c) for rid, c in counts_by_row.items() if c > 0]
-        if attr_name is not None:
-            allowed = set(attr_values or [])
+        matrix, n_rows = self.compiler.stacks.matrix(
+            idx, field, VIEW_STANDARD, shards
+        )
+        filt = self._filter_device(idx, call, shards)
+        if ids is not None:
+            row_ids = jnp.asarray(ids, jnp.int32)
+            prog = self.compiler.program(
+                ("topn_ids", len(shards)),
+                lambda: jax.jit(
+                    lambda m, r, f: jax.vmap(
+                        ops.topn.candidate_counts, in_axes=(0, None, 0)
+                    )(m, r, f)
+                    .astype(jnp.int64)
+                    .sum(axis=0)
+                ),
+            )
+            counts = np.asarray(prog(matrix, row_ids, filt))
             pairs = [
-                (rid, c)
-                for rid, c in pairs
-                if (field.row_attrs.attrs(rid).get(attr_name) in allowed)
+                (int(r), int(c)) for r, c in zip(ids, counts.tolist()) if c > 0
+            ]
+        else:
+            prog = self.compiler.program(
+                ("topn", len(shards)),
+                lambda: jax.jit(
+                    lambda m, f: jax.vmap(ops.matrix_filter_counts)(m, f)
+                    .astype(jnp.int64)
+                    .sum(axis=0)
+                ),
+            )
+            counts = np.asarray(prog(matrix, filt))
+            nz = np.flatnonzero(counts)
+            pairs = [(int(r), int(counts[r])) for r in nz.tolist()]
+
+        if attr_name is not None:
+            allowed = set(attr_values)
+            pairs = [
+                (r, c)
+                for r, c in pairs
+                if field.row_attrs.attrs(r).get(attr_name) in allowed
             ]
         pairs.sort(key=lambda rc: (-rc[1], rc[0]))
         if n is not None:
@@ -475,74 +364,84 @@ class Executor:
         ):
             raise ExecutionError("GroupBy aggregate must be Sum(field=...)")
         agg_field = self._agg_field(idx, aggregate) if aggregate is not None else None
+        agg_slices = (
+            self._bsi_stacked(idx, agg_field, shards) if agg_field is not None else None
+        )
 
         fields: list[Field] = []
         row_lists: list[list[int]] = []
+        matrices = []
         for ch in call.children:
             f = self._field(idx, self._call_field_name(ch))
             fields.append(f)
             rows = self._rows_of_field(f, shards)
-            rlimit = ch.arg("limit")
             prev = ch.arg("previous")
             if prev is not None:
                 prev_id = self._row_id(f, prev)
                 rows = [r for r in rows if r > (prev_id if prev_id is not None else -1)]
+            rlimit = ch.arg("limit")
             if rlimit is not None:
                 rows = rows[:rlimit]
             row_lists.append(rows)
+            matrices.append(
+                self.compiler.stacks.matrix(idx, f, VIEW_STANDARD, shards)[0]
+            )
+
+        # one-dispatch-per-node helpers
+        step = self.compiler.program(
+            ("gb_step", len(shards)),
+            lambda: jax.jit(
+                lambda mask, matrix, row: (
+                    lambda nm: (nm, jnp.sum(ops.popcount_rows(nm).astype(jnp.int64)))
+                )(
+                    mask
+                    & jnp.take(
+                        matrix, row, axis=1, mode="fill", fill_value=0
+                    )
+                )
+            ),
+        )
+        sum_prog = (
+            self._sum_program(agg_field, len(shards)) if agg_field is not None else None
+        )
+
+        if filter_call is not None:
+            if not isinstance(filter_call, Call):
+                raise ExecutionError("GroupBy filter must be a call")
+            base_mask = self._filter_device(
+                idx, Call("_", {}, [filter_call]), shards
+            )
+        else:
+            base_mask = self.compiler.ones(len(shards))
 
         results: list[dict] = []
 
-        def recurse(level: int, group: list[tuple[Field, int]], masks: dict[int, Any]):
+        def recurse(level: int, group: list[tuple[Field, int]], mask, count):
             if limit is not None and len(results) >= limit:
                 return
             if level == len(fields):
-                count = 0
-                agg_total, agg_n = 0, 0
-                for s in shards:
-                    count += int(ops.popcount(masks[s]))
-                    if agg_field is not None:
-                        slices = self._bsi_slices(agg_field, s)
-                        if slices is not None:
-                            pos, neg, an = ops.bsi.sum_counts(slices, masks[s])
-                            agg_total += ops.bsi.weigh_sum(
-                                np.asarray(pos), np.asarray(neg)
-                            )
-                            agg_n += int(an)
+                # count was computed by the step that produced this mask
                 if count == 0:
                     return
                 entry = {
-                    "group": [
-                        {"field": f.name, "rowID": rid} for f, rid in group
-                    ],
+                    "group": [{"field": f.name, "rowID": rid} for f, rid in group],
                     "count": count,
                 }
-                if agg_field is not None:
-                    entry["sum"] = agg_total
+                if agg_slices is not None:
+                    pos, neg, _n = sum_prog(agg_slices, mask)
+                    entry["sum"] = ops.bsi.weigh_sum(
+                        np.asarray(pos), np.asarray(neg)
+                    )
                 results.append(entry)
                 return
-            f = fields[level]
             for rid in row_lists[level]:
-                new_masks = {}
-                nonzero = False
-                for s in shards:
-                    row_words = self._frag_row_words(f, VIEW_STANDARD, s, rid)
-                    new_masks[s] = ops.w_and(masks[s], row_words)
-                    if not nonzero and int(ops.popcount(new_masks[s])):
-                        nonzero = True
-                if not nonzero:
+                new_mask, cnt = step(mask, matrices[level], jnp.int32(rid))
+                cnt = int(cnt)
+                if cnt == 0:
                     continue  # prune: deeper intersections stay empty
-                recurse(level + 1, group + [(f, rid)], new_masks)
+                recurse(level + 1, group + [(fields[level], rid)], new_mask, cnt)
 
-        base_masks = {}
-        for s in shards:
-            if filter_call is not None:
-                if not isinstance(filter_call, Call):
-                    raise ExecutionError("GroupBy filter must be a call")
-                base_masks[s] = self._bitmap(idx, filter_call, s)
-            else:
-                base_masks[s] = self._ones()
-        recurse(0, [], base_masks)
+        recurse(0, [], base_mask, -1)
         return results
 
     # ------------------------------------------------------------ writes
@@ -626,9 +525,9 @@ class Executor:
         field = self._field(idx, fname)
         row_id = self._row_id(field, row, create=True)
         shards = self._shards(idx, None)
-        for s in shards:
-            words = np.asarray(self._bitmap(idx, call.children[0], s))
-            positions = unpack_words(words)
+        words = self._bitmap_words(idx, call.children[0], shards)
+        for i, s in enumerate(shards):
+            positions = unpack_words(words[i])
             frag = field.create_view_if_not_exists(
                 VIEW_STANDARD
             ).create_fragment_if_not_exists(s)

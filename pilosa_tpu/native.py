@@ -1,0 +1,166 @@
+"""ctypes loader for the native host bitmap kernels.
+
+Builds ``native/bitmap_kernels.cpp`` with g++ on first use (cached next to
+the source), binds it via ctypes, and exposes numpy-signature wrappers.
+Every entry point has a numpy fallback so the package works without a
+toolchain; ``AVAILABLE`` reports which path is live.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native", "bitmap_kernels.cpp")
+_LIB = os.path.join(os.path.dirname(_SRC), "libbitmap_kernels.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+AVAILABLE = False
+
+
+def _build() -> bool:
+    if not os.path.exists(_SRC):
+        return False
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC):
+        return True
+    try:
+        subprocess.run(
+            [
+                "g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                "-o", _LIB + ".tmp", _SRC,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        os.replace(_LIB + ".tmp", _LIB)
+        return True
+    except (subprocess.SubprocessError, OSError, PermissionError):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, AVAILABLE
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        c_u32p = ctypes.POINTER(ctypes.c_uint32)
+        c_u64p = ctypes.POINTER(ctypes.c_uint64)
+        c_i64p = ctypes.POINTER(ctypes.c_int64)
+        for name in ("u32_and", "u32_or", "u32_xor", "u32_andnot"):
+            fn = getattr(lib, name)
+            fn.argtypes = [c_u32p, c_u32p, c_u32p, ctypes.c_int64]
+            fn.restype = None
+        lib.u32_popcount.argtypes = [c_u32p, ctypes.c_int64]
+        lib.u32_popcount.restype = ctypes.c_int64
+        lib.u32_and_popcount.argtypes = [c_u32p, c_u32p, ctypes.c_int64]
+        lib.u32_and_popcount.restype = ctypes.c_int64
+        lib.u32_matrix_filter_counts.argtypes = [
+            c_u32p, c_u32p, ctypes.c_int64, ctypes.c_int64, c_i64p,
+        ]
+        lib.u32_matrix_filter_counts.restype = None
+        lib.pack_positions.argtypes = [c_i64p, ctypes.c_int64, c_u32p, ctypes.c_int64]
+        lib.pack_positions.restype = None
+        lib.unpack_words.argtypes = [c_u32p, ctypes.c_int64, c_i64p]
+        lib.unpack_words.restype = ctypes.c_int64
+        for name in ("u64_union", "u64_intersect", "u64_difference"):
+            fn = getattr(lib, name)
+            fn.argtypes = [c_u64p, ctypes.c_int64, c_u64p, ctypes.c_int64, c_u64p]
+            fn.restype = ctypes.c_int64
+        _lib = lib
+        AVAILABLE = True
+        return lib
+
+
+def _ptr(arr: np.ndarray, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+# ------------------------------------------------------------- public API
+def words_count(words: np.ndarray) -> int:
+    lib = _load()
+    w = np.ascontiguousarray(words, dtype=np.uint32)
+    if lib is None:
+        return int(np.bitwise_count(w).sum())
+    return int(lib.u32_popcount(_ptr(w, ctypes.c_uint32), w.size))
+
+
+def and_count(a: np.ndarray, b: np.ndarray) -> int:
+    lib = _load()
+    a = np.ascontiguousarray(a, dtype=np.uint32)
+    b = np.ascontiguousarray(b, dtype=np.uint32)
+    if lib is None:
+        return int(np.bitwise_count(a & b).sum())
+    return int(lib.u32_and_popcount(_ptr(a, ctypes.c_uint32), _ptr(b, ctypes.c_uint32), a.size))
+
+
+def matrix_filter_counts(matrix: np.ndarray, filt: np.ndarray) -> np.ndarray:
+    lib = _load()
+    m = np.ascontiguousarray(matrix, dtype=np.uint32)
+    f = np.ascontiguousarray(filt, dtype=np.uint32)
+    if lib is None:
+        return np.bitwise_count(m & f[None, :]).sum(axis=1).astype(np.int64)
+    out = np.empty(m.shape[0], dtype=np.int64)
+    lib.u32_matrix_filter_counts(
+        _ptr(m, ctypes.c_uint32), _ptr(f, ctypes.c_uint32),
+        m.shape[0], m.shape[1], _ptr(out, ctypes.c_int64),
+    )
+    return out
+
+
+def pack_positions(positions: np.ndarray, width: int) -> np.ndarray:
+    lib = _load()
+    p = np.ascontiguousarray(positions, dtype=np.int64)
+    if p.size and (int(p.min()) < 0 or int(p.max()) >= width):
+        # the C path writes unchecked; keep the numpy path's bounds contract
+        raise IndexError(
+            f"position out of range [0, {width}): min={p.min()}, max={p.max()}"
+        )
+    n_words = width // 32
+    if lib is None:
+        words = np.zeros(n_words, dtype=np.uint32)
+        if p.size:
+            np.bitwise_or.at(words, p >> 5, np.uint32(1) << (p & 31).astype(np.uint32))
+        return words
+    words = np.empty(n_words, dtype=np.uint32)
+    lib.pack_positions(_ptr(p, ctypes.c_int64), p.size, _ptr(words, ctypes.c_uint32), n_words)
+    return words
+
+
+def unpack_words(words: np.ndarray) -> np.ndarray:
+    lib = _load()
+    w = np.ascontiguousarray(words, dtype=np.uint32)
+    if lib is None:
+        bits = np.unpackbits(w.view(np.uint8), bitorder="little")
+        return np.flatnonzero(bits).astype(np.int64)
+    out = np.empty(int(words_count(w)), dtype=np.int64)
+    n = lib.unpack_words(_ptr(w, ctypes.c_uint32), w.size, _ptr(out, ctypes.c_int64))
+    return out[:n]
+
+
+def u64_merge(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Sorted-unique uint64 set merge: op ∈ {union, intersect, difference}."""
+    lib = _load()
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    if lib is None:
+        if op == "union":
+            return np.union1d(a, b)
+        if op == "intersect":
+            return np.intersect1d(a, b, assume_unique=True)
+        return np.setdiff1d(a, b, assume_unique=True)
+    out = np.empty(a.size + b.size, dtype=np.uint64)
+    fn = getattr(lib, f"u64_{op}" if op != "intersect" else "u64_intersect")
+    n = fn(_ptr(a, ctypes.c_uint64), a.size, _ptr(b, ctypes.c_uint64), b.size, _ptr(out, ctypes.c_uint64))
+    return out[:n]

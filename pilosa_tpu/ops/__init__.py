@@ -14,7 +14,7 @@ import jax
 
 jax.config.update("jax_enable_x64", True)
 
-from pilosa_tpu.ops import bsi, topn
+from pilosa_tpu.ops import bsi, similarity, topn
 from pilosa_tpu.ops.bitwise import (
     column_mask,
     count_and,
@@ -35,6 +35,7 @@ from pilosa_tpu.ops.bitwise import (
 
 __all__ = [
     "bsi",
+    "similarity",
     "topn",
     "column_mask",
     "count_and",

@@ -1,0 +1,139 @@
+"""Pallas TPU kernels for the hot query loops.
+
+Hand-scheduled versions of the two dominant scans (reference: the CPU hot
+loops in roaring/roaring.go intersectionCount and fragment.go top):
+
+- ``count_and``            — fused AND+popcount reduction over packed words
+- ``matrix_filter_counts`` — per-row masked popcount over a row matrix
+
+Both stream HBM→VMEM in tiles sized for the VPU (uint32 lanes) and emit
+per-block partials, so the only HBM traffic is one read of each operand.
+
+Measured on v5e (2026-07, this repo's micro-harness): at small/medium
+operand sizes (≤ ~100 MB) these kernels beat the XLA fusion of the jnp
+versions by ~1.5× (2.4 ms → 1.6 ms on 33 MB operands); at GB-scale XLA's
+fusion pipelines better (285 GB/s vs 152 GB/s), so the executor/bench
+default remains the jnp path and these kernels serve the small-scan
+regime and host future fusions XLA can't express (e.g. AND+popcount+
+top-k in one pass). On non-TPU backends they fall back to jnp.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from pilosa_tpu.ops import bitwise
+
+# words per grid step for the 1-D reduction (8 MiB of uint32 per operand
+# tile would be too big; 128K words = 512 KiB/operand keeps VMEM happy)
+BLOCK_WORDS = 128 * 1024
+ROW_BLOCK = 8
+MF_BLOCK_WORDS = 16 * 1024
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+_LANES = 2048  # minor dim of the 2-D view of the word stream
+_BLOCK_ROWS = 256  # 256×2048 uint32 = 2 MiB per operand tile (double-buffered)
+
+
+def _count_and_kernel(a_ref, b_ref, out_ref):
+    words = jnp.bitwise_and(a_ref[...], b_ref[...])
+    pc = jax.lax.population_count(words).astype(jnp.int32)
+    s = jnp.sum(pc, dtype=jnp.int32)
+    out_ref[...] = jnp.full((1, 8, 128), s, jnp.int32)
+
+
+@jax.jit
+def _count_and_partials(a, b):
+    rows = a.shape[-1] // _LANES
+    a2 = a.reshape(rows, _LANES)
+    b2 = b.reshape(rows, _LANES)
+    blocks = rows // _BLOCK_ROWS
+    return pl.pallas_call(
+        _count_and_kernel,
+        grid=(blocks,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 8, 128), lambda i: (i, 0, 0)),
+        out_shape=jax.ShapeDtypeStruct((blocks, 8, 128), jnp.int32),
+    )(a2, b2)
+
+
+def _count_and_pallas(a, b):
+    # Mosaic has no 64-bit support; trace the kernel with x64 off (the
+    # process-wide x64 default would promote index-map constants to i64)
+    with jax.enable_x64(False):
+        partials = _count_and_partials(a, b)
+    return jnp.sum(partials[:, 0, 0].astype(jnp.int64))
+
+
+def count_and(a, b):
+    """Fused popcount(a & b) → int64 scalar. Pallas on TPU when the word
+    count tiles evenly; jnp elsewhere."""
+    if _on_tpu() and a.ndim == 1 and a.shape[-1] % (_LANES * _BLOCK_ROWS) == 0:
+        return _count_and_pallas(a, b)
+    return bitwise.count_and(a, b).astype(jnp.int64)
+
+
+def _mf_counts_kernel(m_ref, f_ref, acc_ref):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    tile = jnp.bitwise_and(m_ref[...], f_ref[...][None, :])
+    partial = jnp.sum(
+        jax.lax.population_count(tile).astype(jnp.int32), axis=1, dtype=jnp.int32
+    )
+    acc_ref[...] += jnp.broadcast_to(partial[:, None], (ROW_BLOCK, 128))
+
+
+def _mf_counts_pallas(matrix, filt):
+    with jax.enable_x64(False):
+        return _mf_counts_inner(matrix, filt)
+
+
+@jax.jit
+def _mf_counts_inner(matrix, filt):
+    rows, words = matrix.shape
+    grid = (rows // ROW_BLOCK, words // MF_BLOCK_WORDS)
+    out = pl.pallas_call(
+        _mf_counts_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((ROW_BLOCK, MF_BLOCK_WORDS), lambda i, j: (i, j)),
+            pl.BlockSpec((MF_BLOCK_WORDS,), lambda i, j: (j,)),
+        ],
+        out_specs=pl.BlockSpec((ROW_BLOCK, 128), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, 128), jnp.int32),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")
+        ),
+    )(matrix, filt)
+    return out[:, 0]
+
+
+def matrix_filter_counts(matrix, filt):
+    """Per-row popcount(matrix & filt) → int32[rows]."""
+    if (
+        _on_tpu()
+        and matrix.ndim == 2
+        and matrix.shape[0] % ROW_BLOCK == 0
+        and matrix.shape[1] % MF_BLOCK_WORDS == 0
+    ):
+        return _mf_counts_pallas(matrix, filt)
+    return bitwise.matrix_filter_counts(matrix, filt)

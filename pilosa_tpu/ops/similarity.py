@@ -1,0 +1,77 @@
+"""Bitmap similarity kernels: Tanimoto / cosine over packed bit vectors.
+
+Reference: docs/examples chemical-similarity (Tanimoto over molecule
+fingerprint rows — upstream implements it as a Pilosa plugin/PQL pattern
+over roaring rows). TPU-native design:
+
+- ``tanimoto_search``: one query fingerprint vs every row of a packed
+  fragment matrix — fused AND+popcount scan (VPU, HBM-bandwidth bound),
+  then top-k. The 10B-bit workload of BASELINE config 5.
+- ``tanimoto_matrix`` / ``cosine_matrix``: all-pairs similarity between
+  two fingerprint sets. Bits are unpacked to {0,1} bf16 and the pairwise
+  intersection counts become ONE MATMUL on the MXU — the op the reference
+  cannot express (its Go loops do pairwise popcounts); this is where the
+  systolic array pays off.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from pilosa_tpu.ops.bitwise import matrix_filter_counts, popcount_rows
+
+
+def tanimoto_search(matrix, query, k: int = 10, threshold: float = 0.0):
+    """Top-k rows of ``matrix`` (uint32[R, W]) by Tanimoto similarity to
+    ``query`` (uint32[W]) → (scores f32[k], row_ids int32[k]).
+
+    tanimoto(a, b) = |a∩b| / (|a| + |b| - |a∩b|)
+    """
+    inter = matrix_filter_counts(matrix, query).astype(jnp.float32)
+    row_pop = popcount_rows(matrix).astype(jnp.float32)
+    q_pop = popcount_rows(query).astype(jnp.float32)
+    union = row_pop + q_pop - inter
+    scores = jnp.where(union > 0, inter / union, 0.0)
+    scores = jnp.where(scores >= threshold, scores, 0.0)
+    k = min(k, scores.shape[0])
+    vals, ids = jax.lax.top_k(scores, k)
+    return vals, ids.astype(jnp.int32)
+
+
+def _unpack_bits_bf16(packed):
+    """uint32[..., W] → bf16[..., W*32] of {0,1} (LSB-first within word)."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    bits = (packed[..., :, None] >> shifts) & jnp.uint32(1)
+    return bits.reshape(*packed.shape[:-1], -1).astype(jnp.bfloat16)
+
+
+def pairwise_intersections(a_packed, b_packed):
+    """All-pairs intersection counts via one MXU matmul.
+
+    a: uint32[N, W], b: uint32[M, W] → f32[N, M] = |a_i ∩ b_j|.
+    """
+    a_bits = _unpack_bits_bf16(a_packed)
+    b_bits = _unpack_bits_bf16(b_packed)
+    return jnp.dot(
+        a_bits, b_bits.T, preferred_element_type=jnp.float32
+    )
+
+
+def tanimoto_matrix(a_packed, b_packed):
+    """All-pairs Tanimoto: f32[N, M]."""
+    inter = pairwise_intersections(a_packed, b_packed)
+    a_pop = popcount_rows(a_packed).astype(jnp.float32)
+    b_pop = popcount_rows(b_packed).astype(jnp.float32)
+    union = a_pop[:, None] + b_pop[None, :] - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def cosine_matrix(a_packed, b_packed):
+    """All-pairs cosine similarity of bit vectors: f32[N, M] =
+    |a∩b| / sqrt(|a|·|b|)."""
+    inter = pairwise_intersections(a_packed, b_packed)
+    a_pop = popcount_rows(a_packed).astype(jnp.float32)
+    b_pop = popcount_rows(b_packed).astype(jnp.float32)
+    denom = jnp.sqrt(a_pop[:, None] * b_pop[None, :])
+    return jnp.where(denom > 0, inter / denom, 0.0)

@@ -196,21 +196,6 @@ def stack_field_matrices(field, shards: list[int]) -> np.ndarray:
     """Stack a field's standard-view fragment matrices → uint32[S, R, W]
     (host-side; rows padded to the max across shards)."""
     from pilosa_tpu.core import VIEW_STANDARD
-    from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+    from pilosa_tpu.executor.compile import stack_view_matrices
 
-    view = field.view(VIEW_STANDARD)
-    mats = []
-    max_rows = 1
-    for s in shards:
-        frag = view.fragment(s) if view else None
-        if frag is None:
-            mats.append(None)
-        else:
-            m, n = frag.device_matrix()
-            mats.append(np.asarray(m))
-            max_rows = max(max_rows, m.shape[0])
-    out = np.zeros((len(shards), max_rows, WORDS_PER_SHARD), dtype=np.uint32)
-    for i, m in enumerate(mats):
-        if m is not None:
-            out[i, : m.shape[0]] = m
-    return out
+    return stack_view_matrices(field.view(VIEW_STANDARD), shards)[0]

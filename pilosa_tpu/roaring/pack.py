@@ -31,24 +31,19 @@ def pack_range(bitmap: Bitmap, start: int, stop: int) -> np.ndarray:
 
 def pack_positions(positions: np.ndarray, width: int) -> np.ndarray:
     """Pack sorted in-range bit positions into uint32[width // 32]."""
-    n_words = width // BITS_PER_WORD
-    words = np.zeros(n_words, dtype=np.uint32)
-    if positions.size:
-        p = positions.astype(np.int64)
-        np.bitwise_or.at(
-            words, p >> 5, (np.uint32(1) << (p & 31).astype(np.uint32))
-        )
-    return words
+    from pilosa_tpu import native
+
+    return native.pack_positions(np.asarray(positions, dtype=np.int64), width)
 
 
 def unpack_words(words: np.ndarray) -> np.ndarray:
     """Set-bit positions (int64, ascending) of packed uint32 words."""
-    bits = np.unpackbits(
-        np.ascontiguousarray(words, dtype=np.uint32).view(np.uint8),
-        bitorder="little",
-    )
-    return np.flatnonzero(bits).astype(np.int64)
+    from pilosa_tpu import native
+
+    return native.unpack_words(words)
 
 
 def words_count(words: np.ndarray) -> int:
-    return int(np.bitwise_count(words).sum())
+    from pilosa_tpu import native
+
+    return native.words_count(words)

@@ -307,3 +307,20 @@ def test_null_conditions(env):
     assert r.columns().tolist() == [1]
     with pytest.raises(ExecutionError):
         q(e, "Row(v > null)")
+
+
+def test_index_recreate_does_not_serve_stale_cache():
+    # regression: StackCache must not alias a deleted index's data
+    h = Holder(None)
+    e = Executor(h)
+    idx = h.create_index("i")
+    idx.create_field("f")
+    e.execute("i", "Set(1, f=0)")
+    (r,) = e.execute("i", "Row(f=0)")
+    assert r.columns().tolist() == [1]
+    h.delete_index("i")
+    idx = h.create_index("i")
+    idx.create_field("f")
+    e.execute("i", "Set(2, f=0)")
+    (r,) = e.execute("i", "Row(f=0)")
+    assert r.columns().tolist() == [2]

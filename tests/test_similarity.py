@@ -1,0 +1,68 @@
+"""Similarity kernel tests vs a Python-set oracle."""
+
+import numpy as np
+
+from pilosa_tpu.ops import similarity
+from pilosa_tpu.roaring import pack_positions
+
+W = 64  # 2048-bit fingerprints
+BITS = W * 32
+
+
+def fingerprints(rng, n, density=0.15):
+    packed = np.zeros((n, W), dtype=np.uint32)
+    sets_ = []
+    for i in range(n):
+        pos = np.flatnonzero(rng.random(BITS) < density).astype(np.int64)
+        packed[i] = pack_positions(pos, BITS)
+        sets_.append(set(pos.tolist()))
+    return packed, sets_
+
+
+def oracle_tanimoto(sa, sb):
+    inter = len(sa & sb)
+    union = len(sa | sb)
+    return inter / union if union else 0.0
+
+
+def test_tanimoto_search(rng):
+    matrix, sets_ = fingerprints(rng, 50)
+    query, qsets = fingerprints(rng, 1)
+    truth = np.array([oracle_tanimoto(s, qsets[0]) for s in sets_])
+    scores, ids = similarity.tanimoto_search(matrix, query[0], k=5)
+    scores, ids = np.asarray(scores), np.asarray(ids)
+    order = np.argsort(-truth)[:5]
+    assert np.allclose(np.sort(scores)[::-1], np.sort(truth[order])[::-1], atol=1e-6)
+    for s, i in zip(scores, ids):
+        assert abs(truth[i] - s) < 1e-6
+
+
+def test_tanimoto_matrix_matches_oracle(rng):
+    a, sa = fingerprints(rng, 12)
+    b, sb = fingerprints(rng, 9)
+    got = np.asarray(similarity.tanimoto_matrix(a, b))
+    for i in range(12):
+        for j in range(9):
+            assert abs(got[i, j] - oracle_tanimoto(sa[i], sb[j])) < 2e-3
+
+
+def test_cosine_matrix_matches_oracle(rng):
+    a, sa = fingerprints(rng, 8)
+    b, sb = fingerprints(rng, 8)
+    got = np.asarray(similarity.cosine_matrix(a, b))
+    for i in range(8):
+        for j in range(8):
+            inter = len(sa[i] & sb[j])
+            denom = (len(sa[i]) * len(sb[j])) ** 0.5
+            expect = inter / denom if denom else 0.0
+            assert abs(got[i, j] - expect) < 2e-3
+
+
+def test_pairwise_intersections_exact_small(rng):
+    # bf16 matmul must still be exact for small counts
+    a, sa = fingerprints(rng, 4, density=0.02)
+    b, sb = fingerprints(rng, 4, density=0.02)
+    got = np.asarray(similarity.pairwise_intersections(a, b))
+    for i in range(4):
+        for j in range(4):
+            assert got[i, j] == len(sa[i] & sb[j])
