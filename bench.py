@@ -10,7 +10,9 @@ same packed representation, resident in HBM.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Scale knobs via env:
-    PILOSA_BENCH_SHARDS   (default 4096  → 4096·2^20 ≈ 4.3B columns)
+    PILOSA_BENCH_SHARDS   (default 10240 → 10240·2^20 ≈ 10.7B columns,
+                           the BASELINE.md north-star scale; 2×1.34GB
+                           operands resident in HBM)
     PILOSA_BENCH_CPU_ITERS / PILOSA_BENCH_TPU_ITERS
 """
 
@@ -29,7 +31,7 @@ def main() -> None:
     from pilosa_tpu import ops
     from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 
-    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "4096"))
+    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
     cpu_iters = int(os.environ.get("PILOSA_BENCH_CPU_ITERS", "5"))
     tpu_iters = int(os.environ.get("PILOSA_BENCH_TPU_ITERS", "50"))
     n_words = n_shards * WORDS_PER_SHARD

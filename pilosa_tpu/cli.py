@@ -111,6 +111,13 @@ def cmd_config(args) -> int:
     return 0
 
 
+def cmd_generate_config(args) -> int:
+    """Alias for `config --generate` (reference has both spellings)."""
+    args.config = None
+    args.generate = True
+    return cmd_config(args)
+
+
 def cmd_check(args) -> int:
     """Validate fragment files are parseable (reference: ctl/check.go)."""
     from pilosa_tpu import roaring
@@ -180,6 +187,11 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--config", default=None)
     s.add_argument("--generate", action="store_true", help="emit a template")
     s.set_defaults(fn=cmd_config)
+
+    s = sub.add_parser(
+        "generate-config", help="emit a TOML config template"
+    )
+    s.set_defaults(fn=cmd_generate_config)
 
     s = sub.add_parser("check", help="validate fragment files")
     s.add_argument("paths", nargs="+")

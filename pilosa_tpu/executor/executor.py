@@ -133,6 +133,8 @@ class Executor:
                 return self._execute_rows(idx, call, shard_list)
             if name == "GroupBy":
                 return self._execute_group_by(idx, call, shard_list)
+            if name == "IncludesColumn":
+                return self._execute_includes_column(idx, call, shard_list)
         except PlanError as e:
             raise ExecutionError(str(e)) from e
         raise ExecutionError(f"unknown call {name!r}")
@@ -445,6 +447,27 @@ class Executor:
         return results
 
     # ------------------------------------------------------------ writes
+    def _execute_includes_column(
+        self, idx: Index, call: Call, shards: list[int]
+    ) -> bool:
+        """IncludesColumn(bitmap, column=N) → bool (reference:
+        executor.go executeIncludesColumnCall). Only the column's own
+        shard is evaluated — one [1, W] program instead of a full scan."""
+        if len(call.children) != 1:
+            raise ExecutionError("IncludesColumn() takes exactly one call")
+        col = call.arg("column")
+        if col is None:
+            raise ExecutionError("IncludesColumn() requires a column argument")
+        col_id = self._col_id(idx, col, create=False)
+        if col_id is None:
+            return False
+        shard = col_id // SHARD_WIDTH
+        if shard not in shards:
+            return False
+        words = self._bitmap_words(idx, call.children[0], [shard])[0]
+        offset = col_id % SHARD_WIDTH
+        return bool((int(words[offset // 32]) >> (offset % 32)) & 1)
+
     def _execute_write(self, idx: Index, call: Call) -> Any:
         name = call.name
         if name == "Set":

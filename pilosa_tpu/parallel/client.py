@@ -136,6 +136,14 @@ class InternalClient:
         return [(e["k"], e["id"]) for e in resp["entries"]]
 
     # --------------------------------------------------------- broadcast
+    def remove_node(self, uri: str, node_id: str, node_uri: str | None = None) -> None:
+        self._json(
+            "POST",
+            uri,
+            "/internal/cluster/resize/remove-node",
+            {"id": node_id, "uri": node_uri, "broadcast": False},
+        )
+
     def send_schema(self, uri: str, schema: dict) -> None:
         self._json("POST", uri, "/schema", schema)
 

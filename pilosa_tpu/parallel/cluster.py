@@ -38,8 +38,11 @@ from pilosa_tpu.parallel.client import (
 from pilosa_tpu.parallel.topology import (
     STATE_DEGRADED,
     STATE_NORMAL,
+    STATE_REMOVED,
+    STATE_RESIZING,
     STATE_STARTING,
     Node,
+    ShardUnavailableError,
     Topology,
 )
 from pilosa_tpu.pql import Call, parse
@@ -47,10 +50,6 @@ from pilosa_tpu.roaring import serialize
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 HEARTBEAT_INTERVAL = 2.0
-
-
-class ShardUnavailableError(RuntimeError):
-    pass
 
 
 class Cluster:
@@ -71,6 +70,11 @@ class Cluster:
         self.topology = Topology([me] + peers, replica_n=self.config.replica_n)
         self.me = me
         self.state = STATE_STARTING
+        self.removed = False  # this node was removed from the cluster
+        # shards this node has ever seen per index (local, remote, or
+        # routed through it) — lets reads FAIL when a sole owner is down
+        # instead of silently returning partial results
+        self._known_shards: dict[str, set[int]] = {}
         self._hb_timer: threading.Timer | None = None
         self._closed = False
 
@@ -84,6 +88,7 @@ class Cluster:
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
         self.server.http.broadcast_schema = self.broadcast_schema
+        self.server.http.broadcast_deletion = self.broadcast_deletion
         self._heartbeat_once()
         self._recover_on_join()
         self.state = STATE_NORMAL
@@ -103,15 +108,29 @@ class Cluster:
 
     def _heartbeat_once(self) -> None:
         degraded = False
+        stale_ids: set[str] = set()
         for n in self._peers(alive_only=False):
             try:
-                self.client.status(n.uri)
+                st = self.client.status(n.uri)
                 n.alive = True
             except PeerError:
                 n.alive = False
                 degraded = True
+                continue
+            # topology reconciliation: a peer that no longer lists a node
+            # observed an administrative removal this node missed (e.g. a
+            # dropped remove-node broadcast). Converge toward removal.
+            # Match on URI, not id: ids are config-dependent (a node's own
+            # id may be its `name` while peers know it by host:port).
+            peer_uris = {d["uri"] for d in st.get("nodes", []) if d.get("uri")}
+            if peer_uris:
+                for x in self.nodes:
+                    if x.uri != n.uri and x.uri not in peer_uris:
+                        stale_ids.add(x.id)
         if self.state in (STATE_NORMAL, STATE_DEGRADED):
             self.state = STATE_DEGRADED if degraded else STATE_NORMAL
+        for x_id in stale_ids:
+            self.remove_node(x_id, broadcast=False)
 
     def _schedule_heartbeat(self) -> None:
         if self._closed:
@@ -123,9 +142,17 @@ class Cluster:
             finally:
                 self._schedule_heartbeat()
 
-        self._hb_timer = threading.Timer(HEARTBEAT_INTERVAL, tick)
+        interval = getattr(self.config, "heartbeat_interval", HEARTBEAT_INTERVAL)
+        self._hb_timer = threading.Timer(interval, tick)
         self._hb_timer.daemon = True
         self._hb_timer.start()
+
+    def _check_not_removed(self) -> None:
+        if self.removed:
+            raise ShardUnavailableError(
+                "this node was removed from the cluster; "
+                "direct client traffic to a cluster member"
+            )
 
     def shard_nodes(self, index: str, shard: int) -> list[Node]:
         return self.topology.shard_nodes(index, shard)
@@ -154,9 +181,18 @@ class Cluster:
             except PeerError:
                 continue
             api.apply_schema(schema)
-            for idx_name in [i["name"] for i in schema.get("indexes", [])]:
+        self._pull_owned_fragments(self._peers())
+
+    def _pull_owned_fragments(self, sources: list[Node]) -> None:
+        """Fetch every fragment this node owns under the CURRENT topology
+        but does not hold locally, from the given source nodes (the data
+        movement half of the reference's ResizeJob)."""
+        api = self.server.api
+        for src in sources:
+            for idx in self.server.holder.schema():
+                idx_name = idx["name"]
                 try:
-                    inventory = self.client.fragment_inventory(peer.uri, idx_name)
+                    inventory = self.client.fragment_inventory(src.uri, idx_name)
                 except PeerError:
                     continue
                 for frag_info in inventory:
@@ -169,11 +205,70 @@ class Cluster:
                         continue
                     try:
                         data = self.client.retrieve_fragment(
-                            peer.uri, idx_name, field, view, shard
+                            src.uri, idx_name, field, view, shard
                         )
                         api.import_roaring(idx_name, field, shard, data, view=view)
                     except PeerError:
                         continue
+
+    def _resolve_node(self, ident: str, uri: str | None = None) -> Node | None:
+        """Find a topology node by id or URI. Ids are config-dependent
+        (name vs host:port), so admin/peer messages may identify a node
+        either way; the URI is canonical."""
+        for n in self.nodes:
+            if n.id == ident or n.uri == ident or n.uri == f"http://{ident}":
+                return n
+            if uri and n.uri == uri:
+                return n
+        return None
+
+    def _broadcast_removal(self, node: Node) -> None:
+        # notify every peer INCLUDING the victim — it must stop accepting
+        # client writes (silently-lost-writes window otherwise); a failed
+        # send is repaired by heartbeat topology reconciliation
+        for n in self._peers(alive_only=False):
+            try:
+                self.client.remove_node(n.uri, node.id, node.uri)
+            except PeerError:
+                pass
+
+    def remove_node(
+        self, ident: str, broadcast: bool = True, uri: str | None = None
+    ) -> bool:
+        """Drop a node from the topology and rebalance: every surviving
+        node re-derives shard ownership and pulls fragments it now owns
+        (reference: cluster.go removeNode → ResizeJob; here each node runs
+        its own pull instead of a coordinator push). When this node itself
+        is the target it enters the REMOVED state: client queries/imports
+        are rejected, but /internal/* data-plane routes keep serving so
+        survivors can drain its fragments. Returns False if the node is
+        unknown."""
+        node = self._resolve_node(ident, uri)
+        if node is None:
+            return False
+        if node.id == self.me.id:
+            # self-removal (admin POSTed remove-node to the node being
+            # decommissioned): tell the survivors FIRST — they rebalance
+            # and drain from us while our internal routes still serve
+            if broadcast:
+                self._broadcast_removal(node)
+            self.removed = True
+            self.state = STATE_REMOVED
+            return True
+        if broadcast:
+            self._broadcast_removal(node)
+        self.state = STATE_RESIZING
+        try:
+            self.topology.remove(node.id)
+            # the removed node (if still reachable) goes first: for
+            # replica_n=1 it is the only holder of its former shards
+            self._pull_owned_fragments([node] + self._peers())
+        finally:
+            if not self.removed:
+                self.state = STATE_NORMAL
+                if any(not n.alive for n in self._peers(alive_only=False)):
+                    self.state = STATE_DEGRADED
+        return True
 
     def _local_fragment(self, index: str, field: str, view: str, shard: int):
         idx = self.server.holder.index(index)
@@ -193,19 +288,46 @@ class Cluster:
             except PeerError:
                 pass
 
+    def broadcast_deletion(self, index: str, field: str | None = None) -> None:
+        """Propagate an index/field deletion to every peer (reference:
+        broadcast.go DeleteIndexMessage/DeleteFieldMessage; apply_schema is
+        additive so deletions need their own message)."""
+        if field is None:
+            self._known_shards.pop(index, None)
+        for n in self._peers(alive_only=False):
+            try:
+                self.client._json(
+                    "POST",
+                    n.uri,
+                    "/internal/schema/delete",
+                    {"index": index, "field": field},
+                )
+                n.alive = True
+            except PeerError:
+                pass
+
     # ----------------------------------------------------------- shard scan
     def global_shards(self, index: str) -> list[int]:
+        """Union of shards across ALL peers. Dead-marked peers are
+        re-probed (same contract as _probe_alive): skipping one silently
+        would return partial query results instead of an error, which is
+        worse than the probe's cost."""
         idx = self.server.holder.index(index)
         shards: set[int] = set(idx.available_shards()) if idx else set()
-        for n in self._peers():
+        for n in self._peers(alive_only=False):
+            if not self._probe_alive(n):
+                continue
             try:
                 shards.update(self.client.node_shards(n.uri, index))
             except PeerError:
                 pass
-        return sorted(shards)
+        known = self._known_shards.setdefault(index, set())
+        known.update(shards)
+        return sorted(known)
 
     # -------------------------------------------------------------- queries
     def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
+        self._check_not_removed()
         calls = parse(pql)
         results = []
         for call in calls:
@@ -217,6 +339,17 @@ class Cluster:
 
     def _route_read(self, index: str, call: Call, shards: list[int] | None) -> Any:
         call = self._translate_read_keys(index, call)
+        if call.name == "IncludesColumn":
+            # only the column's own shard can answer — one RPC, not a fan-out
+            col = call.arg("column")
+            if isinstance(col, (int, np.integer)):
+                col = int(col)
+                if col < 0:
+                    return False  # unknown column key
+                shard = col // SHARD_WIDTH
+                if shards is not None and shard not in shards:
+                    return False
+                shards = [shard]
         all_shards = shards if shards is not None else self.global_shards(index)
         if not all_shards:
             all_shards = [0]
@@ -260,8 +393,35 @@ class Cluster:
             if isinstance(v, str) and f is not None and f.options.keys:
                 rid = self._row_key_lookup(index, k, v)
                 new_args[k] = rid if rid is not None else -1
+            elif k == "column" and isinstance(v, str) and idx.options.keys:
+                cid = self._col_key_lookup(index, v)
+                new_args[k] = cid if cid is not None else -1
         children = [self._translate_read_keys(index, ch) for ch in call.children]
         return Call(call.name, new_args, children, list(call.pos_args))
+
+    def _col_key_lookup(self, index: str, key: str) -> int | None:
+        """Non-creating column-key → id lookup: local store first, then the
+        translate primary (reads must not allocate new ids)."""
+        idx = self.server.holder.index(index)
+        cid = idx.column_keys.translate_key(key, create=False)
+        if cid is not None:
+            return cid
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            return None
+        try:
+            resp = self.client._json(
+                "POST",
+                primary.uri,
+                "/internal/translate/create",
+                {"index": index, "keys": [key], "create": False},
+            )
+        except PeerError:
+            return None
+        cid = resp["ids"][0]
+        if cid is not None:
+            idx.column_keys.apply_entries([(key, cid)])
+        return cid
 
     def _row_key_lookup(self, index: str, field: str, key: str) -> int | None:
         f = self.server.holder.index(index).field(field)
@@ -321,6 +481,7 @@ class Cluster:
                 new_args[fname] = row_id
                 call = Call(call.name, new_args, list(call.children), list(call.pos_args))
             shard = col_id // SHARD_WIDTH
+            self._known_shards.setdefault(index, set()).add(shard)
             result = None
             for owner in self.shard_nodes(index, shard):
                 if not self._probe_alive(owner):
@@ -354,6 +515,7 @@ class Cluster:
 
     # -------------------------------------------------------------- imports
     def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
+        self._check_not_removed()
         api = self.server.api
         idx = self.server.holder.index(index)
         if idx is None:
@@ -371,6 +533,9 @@ class Cluster:
             ]
         cols = np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
         shards = cols // np.uint64(SHARD_WIDTH)
+        self._known_shards.setdefault(index, set()).update(
+            int(s) for s in np.unique(shards).tolist()
+        )
         for shard in np.unique(shards).tolist():
             m = shards == shard
             sub = dict(payload)
@@ -533,6 +698,15 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/translate/create$"),
             ): self._h_translate_create,
+            ("POST", re.compile(r"^/internal/sync$")): self._h_sync,
+            (
+                "POST",
+                re.compile(r"^/internal/schema/delete$"),
+            ): self._h_schema_delete,
+            (
+                "POST",
+                re.compile(r"^/internal/cluster/resize/remove-node$"),
+            ): self._h_remove_node,
         }
         http.extra_routes.update(routes)
 
@@ -582,6 +756,36 @@ class Cluster:
         handler.send_header("Content-Length", str(len(data)))
         handler.end_headers()
         handler.wfile.write(data)
+
+    def _h_schema_delete(self, handler) -> None:
+        body = handler._json_body()
+        index, field = body.get("index"), body.get("field")
+        from pilosa_tpu.executor import ExecutionError
+
+        try:
+            if field:
+                self.server.api.delete_field(index, field)
+            else:
+                self._known_shards.pop(index, None)
+                self.server.api.delete_index(index)
+        except (KeyError, ExecutionError):
+            pass  # already gone — deletion is idempotent cluster-wide
+        handler._json({"success": True})
+
+    def _h_sync(self, handler) -> None:
+        """Manual anti-entropy pass (reference: the AE ticker, triggerable)."""
+        self.sync_holder()
+        handler._json({"success": True})
+
+    def _h_remove_node(self, handler) -> None:
+        body = handler._json_body()
+        node_id = body.get("id")
+        if not node_id:
+            raise ValueError("remove-node requires an 'id'")
+        removed = self.remove_node(
+            node_id, broadcast=body.get("broadcast", True), uri=body.get("uri")
+        )
+        handler._json({"success": removed, "state": self.state})
 
     def _h_inventory(self, handler) -> None:
         index = handler.query_params["index"][0]

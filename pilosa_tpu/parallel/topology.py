@@ -19,6 +19,11 @@ STATE_STARTING = "STARTING"
 STATE_NORMAL = "NORMAL"
 STATE_RESIZING = "RESIZING"
 STATE_DEGRADED = "DEGRADED"
+STATE_REMOVED = "REMOVED"  # this node was removed from the cluster
+
+
+class ShardUnavailableError(RuntimeError):
+    """No alive owner can serve a shard (or this node left the cluster)."""
 
 
 def _fnv1a(data: bytes) -> int:
@@ -64,6 +69,13 @@ class Topology:
             if n.id == node_id:
                 return n
         return None
+
+    def remove(self, node_id: str) -> bool:
+        """Drop a node; shard ownership re-derives from the smaller node
+        list (reference: cluster.go removeNode → ResizeJob placement diff)."""
+        before = len(self.nodes)
+        self.nodes = [n for n in self.nodes if n.id != node_id]
+        return len(self.nodes) < before
 
     def partition_nodes(self, partition_id: int) -> list[Node]:
         """Replica chain for a partition: primary + next ReplicaN-1 nodes

@@ -50,6 +50,7 @@ class API:
         self.cluster = cluster  # None ⇒ single-node
         self.executor = Executor(holder)
         self.stats = stats
+        self.diagnostics = None  # set by Server.open
 
     # ------------------------------------------------------------- schema
     def create_index(self, name: str, options: dict | None = None) -> Index:
@@ -204,10 +205,13 @@ class API:
 
     # -------------------------------------------------------------- info
     def info(self) -> dict:
-        return {
+        out = {
             "shardWidth": SHARD_WIDTH,
             "version": __version__,
         }
+        if self.diagnostics is not None:
+            out["diagnostics"] = self.diagnostics.snapshot()
+        return out
 
     def state(self) -> str:
         return self.cluster.state if self.cluster is not None else "NORMAL"

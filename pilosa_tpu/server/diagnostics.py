@@ -1,0 +1,119 @@
+"""Diagnostics: periodic runtime snapshots of the node.
+
+Reference: diagnostics.go (diagnosticsCollector — hourly phone-home of
+anonymized usage info). This environment has zero egress, so the
+collector writes each snapshot to ``<data_dir>/diagnostics.json`` (and
+keeps the latest in memory for the ``/info`` surface) instead of POSTing
+it; the payload fields mirror the reference's (version, uptime, schema
+shape, runtime gauges).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import platform
+import threading
+import time
+
+
+class DiagnosticsCollector:
+    def __init__(self, server):
+        self.server = server
+        self.start_time = time.time()
+        self._timer: threading.Timer | None = None
+        self._closed = False
+        self.last: dict = {}
+        self._backend_cache: str | None = None
+
+    # ------------------------------------------------------------ snapshot
+    def snapshot(self) -> dict:
+        from pilosa_tpu import __version__
+
+        holder = self.server.holder
+        n_fields = 0
+        n_fragments = 0
+        field_types: dict[str, int] = {}
+        # list() copies: schema writes race this timer thread
+        for idx in list(holder.indexes.values()):
+            for f in list(idx.fields.values()):
+                n_fields += 1
+                field_types[f.options.field_type] = (
+                    field_types.get(f.options.field_type, 0) + 1
+                )
+                for view in list(f.views.values()):
+                    n_fragments += len(view.fragments)
+        snap = {
+            "version": __version__,
+            "time": time.time(),
+            "uptime_seconds": round(time.time() - self.start_time, 1),
+            "node_id": self.server.config.node_id,
+            "num_indexes": len(holder.indexes),
+            "num_fields": n_fields,
+            "num_fragments": n_fragments,
+            "field_types": field_types,
+            "os": platform.system(),
+            "arch": platform.machine(),
+            "python": platform.python_version(),
+            "backend": self._backend(),
+            "cluster_size": (
+                len(self.server.cluster.nodes) if self.server.cluster else 1
+            ),
+        }
+        self.last = snap
+        return snap
+
+    def _backend(self) -> str:
+        # jax.devices() initializes the full backend (seconds on a TPU
+        # host); compute once, off the server-startup path
+        if self._backend_cache is None:
+            try:
+                import jax
+
+                self._backend_cache = jax.devices()[0].platform
+            except Exception:
+                self._backend_cache = "unavailable"
+        return self._backend_cache
+
+    # ------------------------------------------------------------ lifecycle
+    def flush(self) -> None:
+        """Take a snapshot and persist it (the phone-home analogue)."""
+        snap = self.snapshot()
+        data_dir = os.path.expanduser(self.server.config.data_dir)
+        try:
+            os.makedirs(data_dir, exist_ok=True)
+            tmp = os.path.join(data_dir, ".diagnostics.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump(snap, f, indent=1)
+            os.replace(tmp, os.path.join(data_dir, "diagnostics.json"))
+        except OSError:
+            pass
+
+    def open(self) -> None:
+        interval = self.server.config.diagnostics_interval
+        if interval <= 0:
+            return
+        # first flush off the startup path: _backend() may initialize the
+        # JAX runtime, which must not block Server.open
+        self._first_flush = threading.Thread(target=self.flush, daemon=True)
+        self._first_flush.start()
+        self._schedule(interval)
+
+    def _schedule(self, interval: float) -> None:
+        if self._closed:
+            return
+
+        def tick():
+            try:
+                self.flush()
+            finally:
+                self._schedule(interval)
+
+        self._timer = threading.Timer(interval, tick)
+        self._timer.daemon = True
+        self._timer.start()
+
+    def close(self) -> None:
+        self._closed = True
+        if self._timer is not None:
+            self._timer.cancel()

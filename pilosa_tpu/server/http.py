@@ -28,6 +28,7 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__
 from pilosa_tpu.executor import ExecutionError
+from pilosa_tpu.parallel.topology import ShardUnavailableError
 from pilosa_tpu.pql import PQLError
 from pilosa_tpu.utils import GLOBAL_TRACER, StatsClient
 
@@ -83,19 +84,29 @@ class Handler(BaseHTTPRequestHandler):
             match = pattern.match(parsed.path)
             if match:
                 self.stats.count("http_requests", tags={"route": name})
-                try:
-                    with GLOBAL_TRACER.span(f"http.{name}"):
-                        getattr(self, "h_" + name)(*match.groups())
-                except (ExecutionError, PQLError, ValueError, KeyError) as e:
-                    self._json({"error": str(e)}, code=400)
-                except BrokenPipeError:
-                    pass
-                except Exception as e:  # internal error
-                    self._json({"error": f"internal: {e!r}"}, code=500)
+                with GLOBAL_TRACER.span(f"http.{name}"):
+                    self._guarded(getattr(self, "h_" + name), *match.groups())
                 return
-        handled = self.server.handle_extra(self, method, parsed.path)
-        if not handled:
+        # extra (/internal/*) routes get the same error mapping
+        handled = self._guarded(
+            self.server.handle_extra, self, method, parsed.path
+        )
+        if handled is False:
             self._json({"error": "not found"}, code=404)
+
+    def _guarded(self, fn, *args):
+        """Run a route handler with the error→status mapping applied."""
+        try:
+            return fn(*args)
+        except (ExecutionError, PQLError, ValueError, KeyError) as e:
+            self._json({"error": str(e)}, code=400)
+        except ShardUnavailableError as e:
+            self._json({"error": str(e)}, code=503)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # internal error
+            self._json({"error": f"internal: {e!r}"}, code=500)
+        return None
 
     def do_GET(self):
         self._dispatch("GET")
@@ -144,9 +155,21 @@ class Handler(BaseHTTPRequestHandler):
 
     # -------------------------------------------------------------- routes
     def h_query(self, index: str) -> None:
+        import sys
+        import time
+
         pql = self._body().decode()
+        t0 = time.perf_counter()
         with self.stats.timer("query_seconds", tags={"index": index}):
             resp = self.server.query_router(index, pql, self._shards_param())
+        elapsed = time.perf_counter() - t0
+        slow = self.server.long_query_time
+        if slow > 0 and elapsed >= slow:
+            print(
+                f"[pilosa-tpu] long query ({elapsed:.3f}s) index={index}: "
+                f"{pql[:200]}",
+                file=sys.stderr,
+            )
         self._json(resp)
 
     def h_create_index(self, index: str) -> None:
@@ -157,7 +180,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def h_delete_index(self, index: str) -> None:
         self.api.delete_index(index)
-        self.server.broadcast_schema()
+        self.server.broadcast_deletion(index)
         self._json({"success": True})
 
     def h_get_index(self, index: str) -> None:
@@ -175,7 +198,7 @@ class Handler(BaseHTTPRequestHandler):
 
     def h_delete_field(self, index: str, field: str) -> None:
         self.api.delete_field(index, field)
-        self.server.broadcast_schema()
+        self.server.broadcast_deletion(index, field)
         self._json({"success": True})
 
     def h_import_bits(self, index: str, field: str) -> None:
@@ -254,10 +277,12 @@ class HTTPServer(ThreadingHTTPServer):
         self.api = api
         self.stats = stats or StatsClient()
         self.node_id = "local"
+        self.long_query_time = 0.0
         self.extra_routes: dict = {}
         self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
         self.import_router = self._local_import
         self.broadcast_schema = lambda: None
+        self.broadcast_deletion = lambda index, field=None: None
 
     def _local_import(self, index: str, field: str, payload: dict, values: bool) -> None:
         if values:

@@ -27,6 +27,7 @@ class Server:
         self.cluster = None
         self.api = API(self.holder, stats=self.stats)
         self.http: HTTPServer | None = None
+        self.diagnostics = None
         self._anti_entropy_timer: threading.Timer | None = None
         self._closed = False
 
@@ -38,6 +39,7 @@ class Server:
             (self.config.host, self.config.port), self.api, stats=self.stats
         )
         self.http.node_id = self.config.node_id
+        self.http.long_query_time = self.config.long_query_time
         if self.config.seeds or self.config.coordinator:
             from pilosa_tpu.parallel.cluster import Cluster
 
@@ -46,6 +48,11 @@ class Server:
             self.cluster.open()
         self.http.serve_background()
         self._schedule_anti_entropy()
+        from pilosa_tpu.server.diagnostics import DiagnosticsCollector
+
+        self.diagnostics = DiagnosticsCollector(self)
+        self.api.diagnostics = self.diagnostics
+        self.diagnostics.open()
 
     def _schedule_anti_entropy(self) -> None:
         interval = self.config.anti_entropy_interval
@@ -74,6 +81,8 @@ class Server:
 
     def close(self) -> None:
         self._closed = True
+        if self.diagnostics is not None:
+            self.diagnostics.close()
         if self._anti_entropy_timer is not None:
             self._anti_entropy_timer.cancel()
         if self.cluster is not None:

@@ -23,8 +23,11 @@ class Config:
     replica_n: int = 1
     # background loops
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
+    heartbeat_interval: float = 2.0  # peer liveness probe period
+    diagnostics_interval: float = 3600.0  # snapshot period; 0 disables
     # limits
     max_writes_per_request: int = 5000
+    long_query_time: float = 0.0  # seconds; log slower queries (0 = off)
     # metrics
     metric_service: str = "prometheus"
 
@@ -101,7 +104,10 @@ def config_template() -> str:
         "seeds = []\n"
         "replica-n = 1\n"
         "anti-entropy-interval = 600.0\n"
+        "heartbeat-interval = 2.0\n"
+        "diagnostics-interval = 3600.0\n"
         "max-writes-per-request = 5000\n"
+        "long-query-time = 0.0\n"
         'metric-service = "prometheus"\n'
     )
 

@@ -200,6 +200,171 @@ def test_node_down_degraded_and_catchup(tmp_path):
         shutdown(servers)
 
 
+def test_remove_node_rebalances(tmp_path):
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 7 for s in range(8)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 8, "columnIDs": cols})
+        # remove node 2 (still running, so its shards are fetchable)
+        victim_id = servers[2].cluster.me.id
+        r = call(ports[0], "POST", "/internal/cluster/resize/remove-node",
+                 {"id": victim_id})
+        assert r["success"] is True
+        # surviving nodes dropped it from topology
+        for s in servers[:2]:
+            assert s.cluster.topology.node(victim_id) is None
+            assert len(s.cluster.topology.nodes) == 2
+        # the victim was notified: it rejects client traffic with 503
+        assert servers[2].cluster.removed is True
+        with pytest.raises(urllib.request.HTTPError) as exc:
+            call(ports[2], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert exc.value.code == 503
+        # full data still answerable from the surviving nodes
+        servers[2].close()
+        servers[2] = None
+        for p in ports[:2]:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [8]
+            assert call(p, "GET", "/status")["state"] in ("NORMAL", "DEGRADED")
+    finally:
+        shutdown(servers)
+
+
+def test_remove_node_missed_broadcast_reconciles(tmp_path):
+    """A node that misses the remove-node broadcast converges via
+    heartbeat topology reconciliation."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        victim_id = servers[2].cluster.me.id
+        # node 0 removes the victim WITHOUT broadcasting (simulates the
+        # broadcast to node 1 getting lost)
+        servers[0].cluster.remove_node(victim_id, broadcast=False)
+        assert servers[0].cluster.topology.node(victim_id) is None
+        assert servers[1].cluster.topology.node(victim_id) is not None
+        # node 1's next heartbeat sees node 0's smaller topology and drops
+        # the victim too
+        servers[1].cluster._heartbeat_once()
+        assert servers[1].cluster.topology.node(victim_id) is None
+    finally:
+        shutdown(servers)
+
+
+def test_named_nodes_not_self_removed(tmp_path):
+    """A node with `name` set must not remove itself on heartbeat: peers
+    know it by host:port id, but reconciliation matches on URI."""
+    ports = free_ports(2)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(2):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            name=f"node-{i}",  # ids differ from the seed-derived host:port
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        for s in servers:
+            s.cluster._heartbeat_once()
+        for s in servers:
+            assert s.cluster.removed is False
+            assert s.cluster.state == "NORMAL"
+            assert len(s.cluster.topology.nodes) == 2
+    finally:
+        shutdown(servers)
+
+
+def test_remove_node_posted_to_victim(tmp_path):
+    """Decommissioning by POSTing remove-node to the victim itself must
+    broadcast so survivors rebalance and drain the victim's shards."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 2 for s in range(8)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 8, "columnIDs": cols})
+        victim_id = servers[2].cluster.me.id
+        r = call(ports[2], "POST", "/internal/cluster/resize/remove-node",
+                 {"id": victim_id})
+        assert r["success"] is True and r["state"] == "REMOVED"
+        for s in servers[:2]:
+            assert s.cluster.topology.node(victim_id) is None
+        servers[2].close()
+        servers[2] = None
+        for p in ports[:2]:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [8]
+    finally:
+        shutdown(servers)
+
+
+def test_includes_column_cluster(tmp_path):
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        col = 3 * SHARD_WIDTH + 5
+        call(ports[0], "POST", "/index/i/query", f"Set({col}, f=1)".encode())
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        f"IncludesColumn(Row(f=1), column={col})".encode()
+                        )["results"] == [True]
+            assert call(p, "POST", "/index/i/query",
+                        f"IncludesColumn(Row(f=1), column={col + 1})".encode()
+                        )["results"] == [False]
+    finally:
+        shutdown(servers)
+
+
+def test_manual_sync_route(tmp_path):
+    servers, ports, _ = make_cluster(tmp_path, n=2, replica_n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/query", b"Set(3, f=1)")
+        frag = servers[0].holder.index("i").field("f").view("standard").fragment(0)
+        frag.clear_bit(1, 3)
+        assert call(ports[0], "POST", "/internal/sync", {})["success"] is True
+        assert frag.row_count(1) == 1
+    finally:
+        shutdown(servers)
+
+
+def test_delete_propagates_cluster_wide(tmp_path):
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/field/g", {})
+        cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 4, "columnIDs": cols})
+        # field delete via node 1 reaches node 0 and 2
+        call(ports[1], "DELETE", "/index/i/field/g")
+        for s in servers:
+            assert s.holder.index("i").field("g") is None
+        # index delete via node 2 reaches everyone
+        call(ports[2], "DELETE", "/index/i")
+        for s in servers:
+            assert s.holder.index("i") is None
+        # recreate same name: no stale data resurfaces
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        for p in ports:
+            assert call(p, "POST", "/index/i/query", b"Count(Row(f=1))")["results"] == [0]
+    finally:
+        shutdown(servers)
+
+
 def test_keys_translation_cluster_consistent(tmp_path):
     servers, ports, _ = make_cluster(tmp_path, n=2)
     try:

@@ -1,0 +1,81 @@
+"""Diagnostics collector tests (reference coverage model:
+diagnostics_test.go)."""
+
+import json
+
+import pytest
+
+from pilosa_tpu import cli
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.config import Config
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "d"),
+            anti_entropy_interval=0,
+            diagnostics_interval=3600,
+        )
+    )
+    s.open()
+    yield s
+    s.close()
+
+
+def test_diagnostics_snapshot_written(srv, tmp_path):
+    import time
+
+    path = tmp_path / "d" / "diagnostics.json"
+    # first flush runs on a background thread off the startup path
+    deadline = time.time() + 30
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    assert path.exists()
+    snap = json.loads(path.read_text())
+    assert snap["num_indexes"] == 0
+    assert snap["cluster_size"] == 1
+    assert snap["uptime_seconds"] >= 0
+
+
+def test_diagnostics_tracks_schema(srv, tmp_path):
+    srv.api.create_index("i", {})
+    srv.api.create_field("i", "f", {})
+    srv.api.create_field("i", "v", {"type": "int", "min": 0, "max": 100})
+    snap = srv.diagnostics.snapshot()
+    assert snap["num_indexes"] == 1
+    # _exists + f + v
+    assert snap["field_types"].get("int") == 1
+    assert snap["num_fields"] >= 2
+
+
+def test_diagnostics_disabled(tmp_path):
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "d2"),
+            anti_entropy_interval=0,
+            diagnostics_interval=0,
+        )
+    )
+    s.open()
+    try:
+        import time
+
+        time.sleep(0.2)
+        assert not (tmp_path / "d2" / "diagnostics.json").exists()
+    finally:
+        s.close()
+
+
+def test_generate_config_subcommand(capsys):
+    import tomllib
+
+    assert cli.main(["generate-config"]) == 0
+    out = capsys.readouterr().out
+    cfg = tomllib.loads(out)
+    assert cfg["bind"] == "127.0.0.1:10101"
+    assert cfg["diagnostics-interval"] == 3600.0
+    assert cfg["long-query-time"] == 0.0

@@ -114,6 +114,21 @@ def test_bsi_sum_min_max_range(env, rng):
     assert set(r.columns().tolist()) == {c for c, v in oracle.items() if v == 0}
 
 
+def test_includes_column(env):
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, f"Set(10, f=1) Set({SHARD_WIDTH + 7}, f=1) Set(10, f=2)")
+    assert q(e, "IncludesColumn(Row(f=1), column=10)") == [True]
+    assert q(e, f"IncludesColumn(Row(f=1), column={SHARD_WIDTH + 7})") == [True]
+    assert q(e, "IncludesColumn(Row(f=2), column=11)") == [False]
+    # column in a shard with no data at all
+    assert q(e, f"IncludesColumn(Row(f=1), column={5 * SHARD_WIDTH})") == [False]
+    # composite bitmap argument
+    assert q(e, "IncludesColumn(Intersect(Row(f=1), Row(f=2)), column=10)") == [True]
+    with pytest.raises(ExecutionError):
+        q(e, "IncludesColumn(Row(f=1))")
+
+
 def test_topn(env):
     h, idx, e = env
     idx.create_field("f")
