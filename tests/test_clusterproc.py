@@ -31,7 +31,7 @@ def free_ports(n):
     return ports
 
 
-def call(port, method, path, body=None, timeout=30):
+def call(port, method, path, body=None, timeout=120):
     data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
     req = urllib.request.Request(
         f"http://127.0.0.1:{port}{path}", data=data, method=method
@@ -58,6 +58,9 @@ def procs(tmp_path):
     env = dict(
         os.environ,
         JAX_PLATFORMS="cpu",
+        # the conftest's 8-virtual-device XLA_FLAGS slows subprocess startup
+        # and isn't needed for single-node servers
+        XLA_FLAGS="",
         PILOSA_TPU_SHARD_WIDTH_EXP=os.environ.get("PILOSA_TPU_SHARD_WIDTH_EXP", "16"),
     )
     running = []
