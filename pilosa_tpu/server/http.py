@@ -1,8 +1,9 @@
 """HTTP transport: the reference's route surface over the API façade.
 
-Reference: http/handler.go (gorilla/mux routes). JSON replaces protobuf as
-the primary wire format (content negotiation hook kept); routes and
-payload field names match the reference so existing clients port over:
+Reference: http/handler.go (gorilla/mux routes). JSON is the primary wire
+format with ``application/x-protobuf`` content negotiation on the query
+and import routes (reference parity; see encoding/); routes and payload
+field names match the reference so existing clients port over:
 
     POST   /index/{index}/query?shards=0,2
     POST   /index/{index}                    DELETE /index/{index}
@@ -26,7 +27,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from pilosa_tpu import __version__
+from pilosa_tpu import __version__, encoding
 from pilosa_tpu.executor import ExecutionError
 from pilosa_tpu.parallel.topology import ShardUnavailableError
 from pilosa_tpu.pql import PQLError
@@ -78,11 +79,13 @@ class Handler(BaseHTTPRequestHandler):
     def _dispatch(self, method: str) -> None:
         parsed = urlparse(self.path)
         self.query_params = parse_qs(parsed.query)
+        self.route_name = ""
         for m, pattern, name in _ROUTES:
             if m != method:
                 continue
             match = pattern.match(parsed.path)
             if match:
+                self.route_name = name
                 self.stats.count("http_requests", tags={"route": name})
                 with GLOBAL_TRACER.span(f"http.{name}"):
                     self._guarded(getattr(self, "h_" + name), *match.groups())
@@ -99,14 +102,33 @@ class Handler(BaseHTTPRequestHandler):
         try:
             return fn(*args)
         except (ExecutionError, PQLError, ValueError, KeyError) as e:
-            self._json({"error": str(e)}, code=400)
+            self._error(str(e), code=400)
         except ShardUnavailableError as e:
-            self._json({"error": str(e)}, code=503)
+            self._error(str(e), code=503)
         except BrokenPipeError:
             pass
         except Exception as e:  # internal error
-            self._json({"error": f"internal: {e!r}"}, code=500)
+            if encoding.AVAILABLE and isinstance(e, encoding.DecodeError):
+                self._error(f"bad protobuf body: {e}", code=400)
+            else:
+                self._error(f"internal: {e!r}", code=500)
         return None
+
+    def _error(self, msg: str, code: int) -> None:
+        """Error response in the negotiated wire format (reference:
+        handler errors land in QueryResponse.err / ImportResponse.err for
+        protobuf clients, plain JSON otherwise). Only the query and
+        import routes negotiate protobuf; every other route is JSON on
+        success, so its errors stay JSON too."""
+        if self._wants_proto() and self.route_name.startswith("import"):
+            self._proto(encoding.protoser.import_response_to_bytes(msg), code=code)
+        elif self._wants_proto() and self.route_name == "query":
+            self._proto(
+                encoding.protoser.response_to_bytes({"results": [], "error": msg}),
+                code=code,
+            )
+        else:
+            self._json({"error": msg}, code=code)
 
     def do_GET(self):
         self._dispatch("GET")
@@ -153,15 +175,43 @@ class Handler(BaseHTTPRequestHandler):
             return None
         return [int(s) for s in raw[0].split(",") if s != ""]
 
+    def _proto_body(self) -> bool:
+        """True when the request body is protobuf-encoded."""
+        return encoding.AVAILABLE and encoding.CONTENT_TYPE in self.headers.get(
+            "Content-Type", ""
+        )
+
+    def _wants_proto(self) -> bool:
+        """Content negotiation (reference: http/handler.go checks
+        Content-Type/Accept for application/x-protobuf)."""
+        return self._proto_body() or (
+            encoding.AVAILABLE
+            and encoding.CONTENT_TYPE in self.headers.get("Accept", "")
+        )
+
+    def _proto(self, data: bytes, code: int = 200) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", encoding.CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     # -------------------------------------------------------------- routes
     def h_query(self, index: str) -> None:
         import sys
         import time
 
-        pql = self._body().decode()
+        body = self._body()
+        proto = self._wants_proto()
+        shards = self._shards_param()
+        if self._proto_body():
+            pql, req_shards = encoding.protoser.query_request_from_bytes(body)
+            shards = shards or req_shards
+        else:
+            pql = body.decode()
         t0 = time.perf_counter()
         with self.stats.timer("query_seconds", tags={"index": index}):
-            resp = self.server.query_router(index, pql, self._shards_param())
+            resp = self.server.query_router(index, pql, shards)
         elapsed = time.perf_counter() - t0
         slow = self.server.long_query_time
         if slow > 0 and elapsed >= slow:
@@ -170,7 +220,10 @@ class Handler(BaseHTTPRequestHandler):
                 f"{pql[:200]}",
                 file=sys.stderr,
             )
-        self._json(resp)
+        if proto:
+            self._proto(encoding.protoser.response_to_bytes(resp))
+        else:
+            self._json(resp)
 
     def h_create_index(self, index: str) -> None:
         body = self._json_body()
@@ -201,18 +254,40 @@ class Handler(BaseHTTPRequestHandler):
         self.server.broadcast_deletion(index, field)
         self._json({"success": True})
 
+    def _import_payload(self, values: bool) -> dict:
+        if self._proto_body():
+            body = self._body()
+            if values:
+                return encoding.protoser.import_value_request_from_bytes(body)
+            return encoding.protoser.import_request_from_bytes(body)
+        return self._json_body()
+
+    def _import_ok(self) -> None:
+        if self._wants_proto():
+            self._proto(encoding.protoser.import_response_to_bytes())
+        else:
+            self._json({"success": True})
+
     def h_import_bits(self, index: str, field: str) -> None:
-        self.server.import_router(index, field, self._json_body(), values=False)
-        self._json({"success": True})
+        payload = self._import_payload(values=False)
+        self.server.import_router(index, field, payload, values=False)
+        self._import_ok()
 
     def h_import_values(self, index: str, field: str) -> None:
-        self.server.import_router(index, field, self._json_body(), values=True)
-        self._json({"success": True})
+        payload = self._import_payload(values=True)
+        self.server.import_router(index, field, payload, values=True)
+        self._import_ok()
 
     def h_import_roaring(self, index: str, field: str, shard: str) -> None:
-        view = self.query_params.get("view", ["standard"])[0]
-        self.api.import_roaring(index, field, int(shard), self._body(), view=view)
-        self._json({"success": True})
+        if self._proto_body():
+            data, view = encoding.protoser.import_roaring_request_from_bytes(
+                self._body()
+            )
+        else:
+            data = self._body()
+            view = self.query_params.get("view", ["standard"])[0]
+        self.api.import_roaring(index, field, int(shard), data, view=view)
+        self._import_ok()
 
     def h_get_schema(self) -> None:
         self._json(self.api.schema())

@@ -1,0 +1,271 @@
+"""Protobuf wire-format tests.
+
+Reference: encoding/proto/proto.go (Serializer round trips) and
+http/handler.go content negotiation of application/x-protobuf on the
+query and import routes.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import encoding
+from pilosa_tpu.encoding import protoser
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.config import Config
+
+pytestmark = pytest.mark.skipif(not encoding.AVAILABLE, reason="no protobuf runtime")
+
+
+# ------------------------------------------------------------ round trips
+@pytest.mark.parametrize(
+    "result",
+    [
+        None,
+        True,
+        False,
+        7,
+        {"columns": [1, 5, 9]},
+        {"columns": []},
+        {"keys": ["a", "b"]},
+        {"keys": []},
+        {"columns": [2], "attrs": {"color": "red", "n": 3, "ok": True, "w": 1.5}},
+        {"value": -42, "count": 6},
+        {"rows": [1, 2, 3]},
+        {"rows": [1], "keys": ["x"]},
+        [{"id": 4, "count": 9}, {"id": 1, "key": "k", "count": 2}],
+        [
+            {"group": [{"field": "f", "rowID": 1}], "count": 3},
+            {
+                "group": [
+                    {"field": "f", "rowID": 2},
+                    {"field": "g", "rowID": 0, "rowKey": "z"},
+                ],
+                "count": 5,
+                "sum": -17,
+            },
+        ],
+        [],
+    ],
+)
+def test_result_round_trip(result):
+    q = protoser.result_to_proto(result)
+    back = protoser.result_from_proto(type(q).FromString(q.SerializeToString()))
+    assert back == result
+
+
+def test_response_round_trip():
+    resp = {
+        "results": [5, {"columns": [1, 2]}, [{"id": 1, "count": 2}]],
+        "columnAttrs": [{"id": 9, "attrs": {"name": "x"}}],
+    }
+    back = protoser.response_from_bytes(protoser.response_to_bytes(resp))
+    assert back == resp
+
+
+def test_error_response_round_trip():
+    back = protoser.response_from_bytes(
+        protoser.response_to_bytes({"results": [], "error": "boom"})
+    )
+    assert back["error"] == "boom"
+
+
+def test_query_request_round_trip():
+    data = protoser.query_request_to_bytes("Count(Row(f=1))", shards=[0, 3])
+    pql, shards = protoser.query_request_from_bytes(data)
+    assert pql == "Count(Row(f=1))"
+    assert shards == [0, 3]
+
+
+def test_import_request_round_trip():
+    payload = {"rowIDs": [1, 2], "columnIDs": [10, 20], "timestamps": [100, 200]}
+    assert protoser.import_request_from_bytes(
+        protoser.import_request_to_bytes(payload)
+    ) == payload
+    vpayload = {"columnIDs": [5], "values": [-3]}
+    assert protoser.import_value_request_from_bytes(
+        protoser.import_value_request_to_bytes(vpayload)
+    ) == vpayload
+
+
+# ---------------------------------------------------------- HTTP handlers
+@pytest.fixture
+def srv(tmp_path):
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "data"),
+            anti_entropy_interval=0,
+        )
+    )
+    s.open()
+    yield s
+    s.close()
+
+
+def _call(srv, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=body,
+        method="POST",
+        headers=headers or {},
+    )
+    with urllib.request.urlopen(req) as resp:
+        return resp.read(), resp.headers.get("Content-Type", "")
+
+
+def test_http_query_protobuf(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+
+    # protobuf QueryRequest in, protobuf QueryResponse out
+    body = protoser.query_request_to_bytes("Set(1, f=1) Set(3, f=1) Count(Row(f=1))")
+    raw, ctype = _call(
+        srv,
+        "/index/i/query",
+        body,
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert ctype == protoser.CONTENT_TYPE
+    resp = protoser.response_from_bytes(raw)
+    assert resp["results"] == [True, True, 2]
+
+    # PQL text in + Accept header → protobuf out
+    raw, ctype = _call(
+        srv,
+        "/index/i/query",
+        b"Row(f=1)",
+        {"Accept": protoser.CONTENT_TYPE},
+    )
+    assert ctype == protoser.CONTENT_TYPE
+    assert protoser.response_from_bytes(raw)["results"][0]["columns"] == [1, 3]
+
+
+def test_http_import_protobuf(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    _call(srv, "/index/i/field/v", json.dumps({"options": {"type": "int"}}).encode())
+
+    body = protoser.import_request_to_bytes(
+        {"rowIDs": [1, 1, 2], "columnIDs": [10, 20, 10]}
+    )
+    _call(
+        srv,
+        "/index/i/field/f/import",
+        body,
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    vbody = protoser.import_value_request_to_bytes(
+        {"columnIDs": [10, 20], "values": [7, -2]}
+    )
+    _call(
+        srv,
+        "/index/i/field/v/import-value",
+        vbody,
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+
+    raw, _ = _call(srv, "/index/i/query", b"Count(Row(f=1))")
+    assert json.loads(raw)["results"] == [2]
+    raw, _ = _call(srv, "/index/i/query", b"Sum(field=v)")
+    assert json.loads(raw)["results"] == [{"value": 5, "count": 2}]
+
+
+def test_http_import_protobuf_success_body(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    raw, ctype = _call(
+        srv,
+        "/index/i/field/f/import",
+        protoser.import_request_to_bytes({"rowIDs": [1], "columnIDs": [1]}),
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert ctype == protoser.CONTENT_TYPE
+    assert protoser.import_response_from_bytes(raw) == ""
+
+
+def _call_err(srv, path, body, headers):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=body, method="POST",
+        headers=headers,
+    )
+    try:
+        urllib.request.urlopen(req)
+    except urllib.error.HTTPError as e:
+        return e.code, e.read(), e.headers.get("Content-Type", "")
+    raise AssertionError("expected an HTTP error")
+
+
+def test_http_malformed_protobuf_is_400(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    code, raw, ctype = _call_err(
+        srv,
+        "/index/i/query",
+        b"\xff\xff\xff\xff\xff",
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert code == 400
+    assert ctype == protoser.CONTENT_TYPE
+    assert "protobuf" in protoser.response_from_bytes(raw)["error"]
+
+
+def test_http_query_error_is_proto_encoded(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    code, raw, ctype = _call_err(
+        srv,
+        "/index/i/query",
+        protoser.query_request_to_bytes("Count(Row(nosuch=1))"),
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert code == 400
+    assert ctype == protoser.CONTENT_TYPE
+    assert "nosuch" in protoser.response_from_bytes(raw)["error"]
+
+
+def test_http_import_roaring_protobuf_envelope(srv):
+    from pilosa_tpu.roaring import Bitmap, serialize
+
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    bm = Bitmap()
+    for pos in (1, 3, 60000):  # all in row 0 at the test shard width (2^16)
+        bm.add(pos)
+    body = protoser.import_roaring_request_to_bytes(serialize(bm))
+    raw, ctype = _call(
+        srv,
+        "/index/i/field/f/import-roaring/0",
+        body,
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert ctype == protoser.CONTENT_TYPE
+    assert protoser.import_response_from_bytes(raw) == ""
+    raw, _ = _call(srv, "/index/i/query", b"Count(Row(f=0))")
+    assert json.loads(raw)["results"] == [3]
+
+
+def test_http_non_negotiating_route_error_stays_json(srv):
+    code, raw, ctype = _call_err(
+        srv,
+        "/index/badjson",
+        b"{not json",
+        {"Accept": protoser.CONTENT_TYPE, "Content-Type": "application/json"},
+    )
+    assert code == 400
+    assert ctype == "application/json"
+    assert "error" in json.loads(raw)
+
+
+def test_http_import_error_is_proto_encoded(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    code, raw, ctype = _call_err(
+        srv,
+        "/index/i/field/f/import",
+        protoser.import_request_to_bytes({"rowIDs": [1, 2], "columnIDs": [1]}),
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    assert code == 400
+    assert ctype == protoser.CONTENT_TYPE
+    assert protoser.import_response_from_bytes(raw) != ""
