@@ -261,6 +261,30 @@ class Field:
             frag.clear_bit(BSI_OFFSET + k, col)
         return changed
 
+    def clear_values(self, cols: np.ndarray) -> None:
+        """Batched BSI clear for the given columns (ImportValueRequest
+        with clear=true): drops existence, sign, and every magnitude
+        slice, grouped by shard."""
+        if self.options.field_type != FIELD_INT:
+            raise ValueError(f"field {self.name!r} is not an int field")
+        cols = np.asarray(cols, dtype=np.uint64)
+        view = self.view(VIEW_BSI)
+        if cols.size == 0 or view is None:
+            return
+        shards = cols // np.uint64(SHARD_WIDTH)
+        all_rows = [BSI_EXISTS, BSI_SIGN] + [
+            BSI_OFFSET + k for k in range(self._bit_depth)
+        ]
+        for shard in np.unique(shards).tolist():
+            frag = view.fragment(int(shard))
+            if frag is None:
+                continue
+            c = cols[shards == shard]
+            for row in all_rows:
+                frag.bulk_import(
+                    np.full(c.size, row, dtype=np.uint64), c, clear=True
+                )
+
     # ------------------------------------------------------ bulk imports
     def import_bulk(
         self,

@@ -100,6 +100,7 @@ def result_to_proto(r: Any) -> pb.QueryResult:
             q.type = T_ROW_IDS
             q.row_identifiers.rows.extend(r["rows"])
             q.row_identifiers.keys.extend(r.get("keys", []))
+            q.row_identifiers.keyed = "keys" in r
             return q
     if isinstance(r, list):
         if r and isinstance(r[0], dict) and "group" in r[0]:
@@ -146,7 +147,7 @@ def result_from_proto(q: pb.QueryResult) -> Any:
         return {"value": q.val_count.val, "count": q.val_count.count}
     if q.type == T_ROW_IDS:
         out = {"rows": list(q.row_identifiers.rows)}
-        if q.row_identifiers.keys:
+        if q.row_identifiers.keyed:
             out["keys"] = list(q.row_identifiers.keys)
         return out
     if q.type == T_GROUP_COUNTS:
@@ -293,9 +294,11 @@ def import_roaring_request_to_bytes(data: bytes, view: str = "standard") -> byte
 
 
 def import_roaring_request_from_bytes(body: bytes) -> tuple[bytes, str]:
+    """Returns (data, view); view is "" when the envelope left it unset
+    so the caller can fall back to the ?view= query parameter."""
     m = pb.ImportRoaringRequest()
     m.ParseFromString(body)
-    return m.data, m.view or "standard"
+    return m.data, m.view
 
 
 def import_value_request_from_bytes(data: bytes) -> dict:

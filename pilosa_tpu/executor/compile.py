@@ -41,7 +41,7 @@ from pilosa_tpu.core import (
     Index,
 )
 from pilosa_tpu.core.timequantum import views_by_time_range
-from pilosa_tpu.pql import Call, Condition
+from pilosa_tpu.pql import Call, Condition, coerce_timestamp
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
 
@@ -255,6 +255,13 @@ class _Planner:
         if ts_from is not None or ts_to is not None:
             if field.options.field_type != FIELD_TIME:
                 raise PlanError(f"field {fname!r} is not a time field")
+            raw_from, raw_to = ts_from, ts_to
+            ts_from = coerce_timestamp(ts_from) if ts_from is not None else None
+            ts_to = coerce_timestamp(ts_to) if ts_to is not None else None
+            if raw_from is not None and ts_from is None:
+                raise PlanError(f"bad from= timestamp {raw_from!r}")
+            if raw_to is not None and ts_to is None:
+                raise PlanError(f"bad to= timestamp {raw_to!r}")
             bounds = field.time_bounds()
             if bounds is None:
                 zero = lambda arrays, scalars: jnp.zeros(

@@ -41,7 +41,7 @@ from pilosa_tpu.core import (
 )
 from pilosa_tpu.executor.compile import PlanError, QueryCompiler
 from pilosa_tpu.executor.row import RowResult
-from pilosa_tpu.pql import Call, parse
+from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
@@ -490,8 +490,9 @@ class Executor:
         col = self._col_id(idx, call.pos_args[0], create=call.name == "Set")
         ts = None
         for extra in call.pos_args[1:]:
-            if isinstance(extra, datetime):
-                ts = extra
+            coerced = coerce_timestamp(extra)
+            if coerced is not None:
+                ts = coerced
             else:
                 raise ExecutionError(f"unexpected argument {extra!r}")
         fa = call.field_arg()

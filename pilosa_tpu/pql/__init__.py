@@ -1,6 +1,6 @@
 """L2 query language: PQL parsing (reference: pql/ package)."""
 
 from pilosa_tpu.pql.ast import Call, Condition
-from pilosa_tpu.pql.parser import PQLError, parse
+from pilosa_tpu.pql.parser import PQLError, coerce_timestamp, parse
 
-__all__ = ["Call", "Condition", "parse", "PQLError"]
+__all__ = ["Call", "Condition", "parse", "PQLError", "coerce_timestamp"]

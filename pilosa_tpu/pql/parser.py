@@ -100,6 +100,21 @@ def _parse_ts(s: str) -> datetime:
     raise PQLError(f"bad timestamp {s!r}")
 
 
+def coerce_timestamp(value) -> datetime | None:
+    """Accept a timestamp arg in any form PQL clients send it: already a
+    datetime (bare literal), or a quoted ISO string (the reference's
+    grammar allows both ``from=2006-01-02T15:04`` and
+    ``from="2006-01-02T15:04"``). None / non-timestamp strings → None."""
+    if isinstance(value, datetime):
+        return value
+    if isinstance(value, str):
+        try:
+            return _parse_ts(value)
+        except PQLError:
+            return None
+    return None
+
+
 _COND_FROM_OP = {"<": "<", "<=": "<=", ">": ">", ">=": ">=", "==": "==", "!=": "!="}
 # flip for the "value OP name" between-prefix form: 5 < f  means  f > 5
 _FLIP = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}

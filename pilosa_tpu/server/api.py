@@ -134,6 +134,9 @@ class API:
         idx = self._index(index)
         f = self._field(idx, field)
         cols = self._resolve_cols(idx, payload)
+        if payload.get("clear"):
+            f.clear_values(cols)
+            return
         values = np.asarray(payload.get("values", []), dtype=np.int64)
         if cols.size != values.size:
             raise ExecutionError("columnIDs and values length mismatch")

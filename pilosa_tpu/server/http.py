@@ -183,10 +183,14 @@ class Handler(BaseHTTPRequestHandler):
 
     def _wants_proto(self) -> bool:
         """Content negotiation (reference: http/handler.go checks
-        Content-Type/Accept for application/x-protobuf)."""
+        Content-Type/Accept for application/x-protobuf). An explicit
+        ``Accept: application/json`` wins even for protobuf request
+        bodies (proto-in/JSON-out)."""
+        accept = self.headers.get("Accept", "")
+        if "application/json" in accept:
+            return False
         return self._proto_body() or (
-            encoding.AVAILABLE
-            and encoding.CONTENT_TYPE in self.headers.get("Accept", "")
+            encoding.AVAILABLE and encoding.CONTENT_TYPE in accept
         )
 
     def _proto(self, data: bytes, code: int = 200) -> None:
@@ -279,13 +283,16 @@ class Handler(BaseHTTPRequestHandler):
         self._import_ok()
 
     def h_import_roaring(self, index: str, field: str, shard: str) -> None:
+        param_view = self.query_params.get("view", [""])[0]
         if self._proto_body():
             data, view = encoding.protoser.import_roaring_request_from_bytes(
                 self._body()
             )
+            # envelope view wins; fall back to ?view= then "standard"
+            view = view or param_view or "standard"
         else:
             data = self._body()
-            view = self.query_params.get("view", ["standard"])[0]
+            view = param_view or "standard"
         self.api.import_roaring(index, field, int(shard), data, view=view)
         self._import_ok()
 

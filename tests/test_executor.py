@@ -188,6 +188,18 @@ def test_time_field_range_query(env):
     assert set(r.columns().tolist()) == {1, 2, 3}
 
 
+def test_time_field_quoted_timestamps(env):
+    """Quoted ISO timestamps in Set() and from=/to= behave like bare
+    literals (both forms are valid client PQL)."""
+    h, idx, e = env
+    idx.create_field("t", FieldOptions(field_type="time", time_quantum="YMD"))
+    q(e, 'Set(1, t=1, "2018-01-01T00:00") Set(3, t=1, "2019-01-01T00:00")')
+    (r,) = q(e, 'Row(t=1, from="2018-01-01", to="2018-12-31")')
+    assert set(r.columns().tolist()) == {1}
+    with pytest.raises(ExecutionError):
+        q(e, 'Row(t=1, from="garbage", to="2018-12-31")')
+
+
 def test_store_and_clear_row(env):
     h, idx, e = env
     idx.create_field("f")

@@ -35,6 +35,7 @@ pytestmark = pytest.mark.skipif(not encoding.AVAILABLE, reason="no protobuf runt
         {"value": -42, "count": 6},
         {"rows": [1, 2, 3]},
         {"rows": [1], "keys": ["x"]},
+        {"rows": [], "keys": []},
         [{"id": 4, "count": 9}, {"id": 1, "key": "k", "count": 2}],
         [
             {"group": [{"field": "f", "rowID": 1}], "count": 3},
@@ -243,6 +244,58 @@ def test_http_import_roaring_protobuf_envelope(srv):
     assert protoser.import_response_from_bytes(raw) == ""
     raw, _ = _call(srv, "/index/i/query", b"Count(Row(f=0))")
     assert json.loads(raw)["results"] == [3]
+
+
+def test_http_proto_in_json_out(srv):
+    """Explicit Accept: application/json wins over a protobuf body."""
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    raw, ctype = _call(
+        srv,
+        "/index/i/query",
+        protoser.query_request_to_bytes("Set(1, f=1) Count(Row(f=1))"),
+        {"Content-Type": protoser.CONTENT_TYPE, "Accept": "application/json"},
+    )
+    assert ctype == "application/json"
+    assert json.loads(raw)["results"] == [True, 1]
+
+
+def test_http_import_value_clear(srv):
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/v", json.dumps({"options": {"type": "int"}}).encode())
+    _call(
+        srv,
+        "/index/i/field/v/import-value",
+        protoser.import_value_request_to_bytes({"columnIDs": [1, 2, 3], "values": [5, 6, 7]}),
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    _call(
+        srv,
+        "/index/i/field/v/import-value",
+        protoser.import_value_request_to_bytes({"columnIDs": [2], "values": [0], "clear": True}),
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    raw, _ = _call(srv, "/index/i/query", b"Sum(field=v)")
+    assert json.loads(raw)["results"] == [{"value": 12, "count": 2}]
+
+
+def test_http_import_roaring_envelope_view_param_fallback(srv):
+    from pilosa_tpu.roaring import Bitmap, serialize
+
+    _call(srv, "/index/i", json.dumps({}).encode())
+    _call(srv, "/index/i/field/f", json.dumps({}).encode())
+    bm = Bitmap()
+    bm.add(4)
+    # envelope with unset view + ?view= param → param wins over "standard"
+    body = protoser.import_roaring_request_to_bytes(serialize(bm), view="")
+    _call(
+        srv,
+        "/index/i/field/f/import-roaring/0?view=standard",
+        body,
+        {"Content-Type": protoser.CONTENT_TYPE},
+    )
+    raw, _ = _call(srv, "/index/i/query", b"Count(Row(f=0))")
+    assert json.loads(raw)["results"] == [1]
 
 
 def test_http_non_negotiating_route_error_stays_json(srv):
