@@ -63,6 +63,7 @@ def main() -> None:
     # ---- one-vs-all Tanimoto top-k (fused AND+popcount scan)
     search = jax.jit(similarity.tanimoto_search, static_argnames=("k",))
     scores, ids = search(matrix, query, k=args.k)  # compile + warm
+    jax.block_until_ready((scores, ids))
     t0 = time.perf_counter()
     scores, ids = search(matrix, query, k=args.k)
     jax.block_until_ready((scores, ids))
@@ -77,6 +78,7 @@ def main() -> None:
     block = matrix[:n_block]
     pair = jax.jit(similarity.tanimoto_matrix)
     sims = pair(block, block)  # compile + warm
+    sims.block_until_ready()
     t0 = time.perf_counter()
     sims = pair(block, block)
     sims.block_until_ready()

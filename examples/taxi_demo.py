@@ -14,7 +14,7 @@ Schema (mirrors the reference demo's field layout):
     cab_type          set   (0=yellow 1=green 2=fhv)
     passenger_count   set   (1..6)
     dist_miles        int   BSI, 0..500
-    total_amount      int   BSI, dollars 0..1000
+    total_amount      int   BSI, dollars 0..100000
     pickup_time       time  quantum YMDH
 """
 

@@ -541,8 +541,13 @@ class Cluster:
             sub = dict(payload)
             sub["columnIDs"] = cols[m].tolist()
             if values:
-                vals = payload.get("values", [])
-                sub["values"] = [vals[i] for i in np.flatnonzero(m).tolist()]
+                if payload.get("clear"):
+                    # value-clear carries no values list (api.import_values
+                    # clears the listed columns and returns)
+                    sub.pop("values", None)
+                else:
+                    vals = payload.get("values", [])
+                    sub["values"] = [vals[i] for i in np.flatnonzero(m).tolist()]
             else:
                 rows = payload.get("rowIDs", [])
                 sub["rowIDs"] = [rows[i] for i in np.flatnonzero(m).tolist()]

@@ -47,6 +47,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/index/([^/]+)$"), "create_index"),
     ("DELETE", re.compile(r"^/index/([^/]+)$"), "delete_index"),
     ("GET", re.compile(r"^/index/([^/]+)$"), "get_index"),
+    ("GET", re.compile(r"^/$"), "console"),
     ("GET", re.compile(r"^/schema$"), "get_schema"),
     ("POST", re.compile(r"^/schema$"), "post_schema"),
     ("GET", re.compile(r"^/status$"), "status"),
@@ -295,6 +296,18 @@ class Handler(BaseHTTPRequestHandler):
             view = param_view or "standard"
         self.api.import_roaring(index, field, int(shard), data, view=view)
         self._import_ok()
+
+    def h_console(self) -> None:
+        """Embedded query console (reference parity: the v0.x WebUI,
+        embedded via statik; here one self-contained HTML file)."""
+        import importlib.resources
+
+        html = (
+            importlib.resources.files("pilosa_tpu.server")
+            .joinpath("console.html")
+            .read_text(encoding="utf-8")
+        )
+        self._text(html, content_type="text/html; charset=utf-8")
 
     def h_get_schema(self) -> None:
         self._json(self.api.schema())

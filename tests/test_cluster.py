@@ -143,6 +143,25 @@ def test_cluster_aggregates_reduce(tmp_path):
         shutdown(servers)
 
 
+def test_cluster_import_value_clear(tmp_path):
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/v", {"options": {"type": "int"}})
+        cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+        call(ports[0], "POST", "/index/i/field/v/import-value",
+             {"columnIDs": cols, "values": [10, 20, 30, 40]})
+        # clear two columns across different shards, values list omitted
+        call(ports[0], "POST", "/index/i/field/v/import-value",
+             {"columnIDs": [cols[1], cols[3]], "clear": True})
+        for p in ports:
+            assert call(p, "POST", "/index/i/query", b"Sum(field=v)")["results"] == [
+                {"value": 40, "count": 2}
+            ]
+    finally:
+        shutdown(servers)
+
+
 def test_replication_and_anti_entropy(tmp_path):
     servers, ports, _ = make_cluster(tmp_path, n=3, replica_n=2)
     try:

@@ -48,6 +48,15 @@ def test_full_http_workflow(srv):
     assert idx["name"] == "i"
 
 
+def test_console_served_at_root(srv):
+    raw = call(srv, "GET", "/", raw=True)
+    html = raw.decode()
+    assert html.startswith("<!DOCTYPE html>")
+    # the console drives these endpoints; keep the markers stable
+    for marker in ("/schema", "/status", "query", "pilosa-tpu"):
+        assert marker in html
+
+
 def test_import_endpoints(srv):
     call(srv, "POST", "/index/i", {})
     call(srv, "POST", "/index/i/field/f", {})
