@@ -11,11 +11,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import urllib.request
 
 import numpy as np
+
+
+def _apply_jax_platform_env() -> None:
+    """Honor JAX_PLATFORMS even when a site-installed PJRT plugin hook
+    swallows the env var: an explicit config update before first backend
+    use always wins. Without this, ``JAX_PLATFORMS=cpu pilosa_tpu
+    server`` can hang in an unrelated accelerator plugin's init."""
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
 
 
 def _http(method: str, url: str, body: bytes | None = None, ctype: str = "application/json"):
@@ -155,6 +168,7 @@ def cmd_inspect(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
+    _apply_jax_platform_env()
     p = argparse.ArgumentParser(prog="pilosa-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 

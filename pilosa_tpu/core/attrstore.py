@@ -3,6 +3,13 @@
 Reference: attrstore.go + boltdb/attrstore.go (AttrStore; attrs synced via
 100-ID block checksums). BoltDB is replaced by a JSON file persisted on
 mutation; the block-checksum diff surface is kept for anti-entropy.
+
+Divergence from the reference, deliberate: every attribute key carries a
+last-writer-wins timestamp, and deletions are kept as tombstones. The
+reference's block merge is a plain union, which silently resurrects
+deleted attrs when a node that missed the delete broadcast rejoins; with
+LWW metadata the anti-entropy merge converges on the newest write
+(including deletes) instead.
 """
 
 from __future__ import annotations
@@ -11,22 +18,43 @@ import hashlib
 import json
 import os
 import threading
+import time
 
 ATTR_BLOCK_SIZE = 100
+
+# tombstones older than this are pruned; must exceed the longest node
+# outage you expect anti-entropy to repair, or a delete can resurrect
+TOMBSTONE_TTL_SECONDS = 7 * 24 * 3600.0
+
+# value sentinel for a deleted key inside the versioned cell
+_TOMBSTONE = None
 
 
 class AttrStore:
     def __init__(self, path: str | None = None):
         self.path = path
         self._lock = threading.RLock()
-        self._attrs: dict[int, dict] = {}
+        # id → key → [value-or-None(tombstone), lww-timestamp]
+        self._cells: dict[int, dict[str, list]] = {}
 
     def open(self) -> None:
         with self._lock:
             if self.path and os.path.exists(self.path):
                 with open(self.path) as f:
                     raw = json.load(f)
-                self._attrs = {int(k): v for k, v in raw.items()}
+                if raw.get("_v") == 2:
+                    self._cells = {
+                        int(k): {a: list(cell) for a, cell in v.items()}
+                        for k, v in raw["cells"].items()
+                    }
+                else:  # v1 format: plain id → attrs dict, no versions.
+                    # Stamp ts=0 so any real (timestamped) write or delete
+                    # elsewhere in the cluster wins over migrated data.
+                    self._cells = {
+                        int(k): {a: [val, 0.0] for a, val in v.items()}
+                        for k, v in raw.items()
+                        if not k.startswith("_")
+                    }
 
     def close(self) -> None:
         pass
@@ -37,53 +65,94 @@ class AttrStore:
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
         tmp = self.path + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({str(k): v for k, v in self._attrs.items()}, f)
+            json.dump(
+                {
+                    "_v": 2,
+                    "cells": {str(k): v for k, v in self._cells.items()},
+                },
+                f,
+            )
         os.replace(tmp, self.path)
 
-    def set_attrs(self, id_: int, attrs: dict) -> None:
-        """Merge attrs for an ID; null values delete keys (reference:
-        AttrStore.SetAttrs)."""
+    def set_attrs(self, id_: int, attrs: dict, ts: float | None = None) -> None:
+        """Merge attrs for an ID; null values delete keys — kept as
+        tombstones so the delete wins anti-entropy merges (reference:
+        AttrStore.SetAttrs). ``ts`` lets a cluster coordinator stamp one
+        timestamp on every replica of a broadcast write so LWW never
+        compares unsynchronized node clocks."""
         with self._lock:
-            current = self._attrs.setdefault(id_, {})
+            now = time.time() if ts is None else ts
+            cells = self._cells.setdefault(id_, {})
             for k, v in attrs.items():
-                if v is None:
-                    current.pop(k, None)
-                else:
-                    current[k] = v
-            if not current:
-                self._attrs.pop(id_, None)
+                # same newer-ts-wins rule as merge_block: a delayed
+                # out-of-order broadcast must not regress a newer write
+                if k in cells and cells[k][1] > now:
+                    continue
+                cells[k] = [_TOMBSTONE if v is None else v, now]
+            self._prune_tombstones()
             self._persist()
+
+    def _prune_tombstones(self) -> None:
+        """Drop tombstones past TTL (and then-empty IDs) so churny
+        delete workloads don't grow the store without bound."""
+        horizon = time.time() - TOMBSTONE_TTL_SECONDS
+        for id_ in list(self._cells):
+            cells = self._cells[id_]
+            for k in [
+                k
+                for k, c in cells.items()
+                if c[0] is _TOMBSTONE and c[1] < horizon
+            ]:
+                del cells[k]
+            if not cells:
+                del self._cells[id_]
 
     def attrs(self, id_: int) -> dict:
         with self._lock:
-            return dict(self._attrs.get(id_, {}))
+            return {
+                k: cell[0]
+                for k, cell in self._cells.get(id_, {}).items()
+                if cell[0] is not _TOMBSTONE
+            }
 
     def block_checksums(self) -> list[tuple[int, bytes]]:
+        """Checksums cover the versioned cells (tombstones included) so
+        two stores agree exactly when their merge states agree."""
         with self._lock:
             blocks: dict[int, list[int]] = {}
-            for id_ in self._attrs:
+            for id_ in self._cells:
                 blocks.setdefault(id_ // ATTR_BLOCK_SIZE, []).append(id_)
             out = []
             for block_id in sorted(blocks):
                 h = hashlib.blake2b(digest_size=16)
                 for id_ in sorted(blocks[block_id]):
                     h.update(
-                        json.dumps(
-                            [id_, self._attrs[id_]], sort_keys=True
-                        ).encode()
+                        json.dumps([id_, self._cells[id_]], sort_keys=True).encode()
                     )
                 out.append((block_id, h.digest()))
             return out
 
     def block_data(self, block_id: int) -> dict[int, dict]:
+        """id → {key: [value, ts]} for one block, tombstones included."""
         with self._lock:
             lo = block_id * ATTR_BLOCK_SIZE
             hi = lo + ATTR_BLOCK_SIZE
-            return {i: dict(a) for i, a in self._attrs.items() if lo <= i < hi}
+            return {
+                i: {k: list(c) for k, c in cells.items()}
+                for i, cells in self._cells.items()
+                if lo <= i < hi
+            }
 
     def merge_block(self, data: dict[int, dict]) -> None:
+        """Key-wise LWW merge of a peer's block (anti-entropy repair):
+        the newer timestamp wins, so missed deletes propagate instead of
+        being resurrected."""
         with self._lock:
-            for id_, attrs in data.items():
-                current = self._attrs.setdefault(int(id_), {})
-                current.update(attrs)
+            for id_, cells in data.items():
+                mine = self._cells.setdefault(int(id_), {})
+                for k, cell in cells.items():
+                    value, ts = cell[0], cell[1]
+                    if k not in mine or mine[k][1] < ts:
+                        mine[k] = [value, ts]
+            self._prune_tombstones()
             self._persist()

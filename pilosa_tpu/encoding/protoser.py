@@ -277,6 +277,39 @@ def import_request_from_bytes(data: bytes) -> dict:
     return out
 
 
+def translate_keys_request_to_bytes(
+    index: str, keys: list[str], field: str = "", create: bool = True
+) -> bytes:
+    return pb.TranslateKeysRequest(
+        index=index, field=field, keys=keys, lookup_only=not create
+    ).SerializeToString()
+
+
+def translate_keys_request_from_bytes(data: bytes) -> dict:
+    m = pb.TranslateKeysRequest()
+    m.ParseFromString(data)
+    return {
+        "index": m.index,
+        "field": m.field,
+        "keys": list(m.keys),
+        "create": not m.lookup_only,
+    }
+
+
+def translate_keys_response_to_bytes(ids: list[int | None]) -> bytes:
+    """None (key not found on a lookup-only request) maps to 0 — IDs
+    start at 1, so 0 is unambiguous."""
+    return pb.TranslateKeysResponse(
+        ids=[i or 0 for i in ids]
+    ).SerializeToString()
+
+
+def translate_keys_response_from_bytes(data: bytes) -> list[int]:
+    m = pb.TranslateKeysResponse()
+    m.ParseFromString(data)
+    return list(m.ids)
+
+
 def import_value_request_to_bytes(payload: dict) -> bytes:
     m = pb.ImportValueRequest()
     m.index = payload.get("index", "")

@@ -25,13 +25,20 @@ class InternalClient:
         self.timeout = timeout
 
     def _request(
-        self, method: str, uri: str, path: str, body: bytes | None = None
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: bytes | None = None,
+        timeout: float | None = None,
     ) -> bytes:
         req = urllib.request.Request(uri + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", "application/json")
         try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+            with urllib.request.urlopen(
+                req, timeout=self.timeout if timeout is None else timeout
+            ) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
@@ -39,9 +46,18 @@ class InternalClient:
         except OSError as e:
             raise PeerError(uri, str(e)) from e
 
-    def _json(self, method: str, uri: str, path: str, body: dict | None = None) -> dict:
+    def _json(
+        self,
+        method: str,
+        uri: str,
+        path: str,
+        body: dict | None = None,
+        timeout: float | None = None,
+    ) -> dict:
         payload = json.dumps(body).encode() if body is not None else None
-        return json.loads(self._request(method, uri, path, payload) or b"{}")
+        return json.loads(
+            self._request(method, uri, path, payload, timeout=timeout) or b"{}"
+        )
 
     # ------------------------------------------------------------ queries
     def query_node(
@@ -61,8 +77,10 @@ class InternalClient:
         resp = self._json("GET", uri, f"/internal/shards?index={index}")
         return resp["shards"]
 
-    def status(self, uri: str) -> dict:
-        return self._json("GET", uri, "/status")
+    def status(self, uri: str, timeout: float | None = None) -> dict:
+        """Liveness probe; callers pass a short timeout so a hung peer
+        doesn't stall heartbeats for the full data-plane timeout."""
+        return self._json("GET", uri, "/status", timeout=timeout)
 
     # ------------------------------------------------------------ imports
     def import_node(
@@ -107,6 +125,28 @@ class InternalClient:
         )
         return resp["rows"], resp["cols"]
 
+    def set_attrs(self, uri: str, payload: dict) -> None:
+        """Apply a coordinator-timestamped attr write on a peer."""
+        self._json("POST", uri, "/internal/attrs/set", payload)
+
+    def attr_blocks(self, uri: str, index: str, field: str | None) -> dict[int, str]:
+        """Attr-store block id → checksum hex; field=None targets the
+        index's column attrs (reference: attr block sync)."""
+        path = f"/internal/attrs/blocks?index={index}"
+        if field:
+            path += f"&field={field}"
+        resp = self._json("GET", uri, path)
+        return {int(b["block"]): b["checksum"] for b in resp["blocks"]}
+
+    def attr_block_data(
+        self, uri: str, index: str, field: str | None, block: int
+    ) -> dict[int, dict]:
+        path = f"/internal/attrs/block/data?index={index}&block={block}"
+        if field:
+            path += f"&field={field}"
+        resp = self._json("GET", uri, path)
+        return {int(k): v for k, v in resp["attrs"].items()}
+
     def retrieve_fragment(
         self, uri: str, index: str, field: str, view: str, shard: int
     ) -> bytes:
@@ -145,7 +185,9 @@ class InternalClient:
         )
 
     def send_schema(self, uri: str, schema: dict) -> None:
-        self._json("POST", uri, "/schema", schema)
+        """Peer schema sync; the internal route skips create-time name
+        validation so replication of pre-validation names never fails."""
+        self._json("POST", uri, "/internal/schema/apply", schema)
 
 
 def encode_words_b64(words) -> str:

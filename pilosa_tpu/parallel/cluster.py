@@ -111,7 +111,7 @@ class Cluster:
         stale_ids: set[str] = set()
         for n in self._peers(alive_only=False):
             try:
-                st = self.client.status(n.uri)
+                st = self.client.status(n.uri, timeout=5.0)
                 n.alive = True
             except PeerError:
                 n.alive = False
@@ -164,7 +164,7 @@ class Cluster:
         if node.id == self.me.id or node.alive:
             return True
         try:
-            self.client.status(node.uri)
+            self.client.status(node.uri, timeout=5.0)
             node.alive = True
         except PeerError:
             node.alive = False
@@ -180,7 +180,7 @@ class Cluster:
                 schema = self.client._json("GET", peer.uri, "/schema")
             except PeerError:
                 continue
-            api.apply_schema(schema)
+            api.apply_schema(schema, validate=False)
         self._pull_owned_fragments(self._peers())
 
     def _pull_owned_fragments(self, sources: list[Node]) -> None:
@@ -464,6 +464,8 @@ class Cluster:
     def _route_write(self, index: str, call: Call) -> Any:
         # single-column writes go to every owner of the column's shard;
         # row-wide / attr writes broadcast to every node
+        if call.name in ("SetRowAttrs", "SetColumnAttrs"):
+            return self._route_attr_write(index, call)
         if call.name in ("Set", "Clear") and call.pos_args:
             col = call.pos_args[0]
             if isinstance(col, str):
@@ -512,6 +514,62 @@ class Cluster:
             else:
                 result = r if result is None else result
         return result
+
+    def _route_attr_write(self, index: str, call: Call) -> None:
+        """Attr writes broadcast with ONE coordinator-assigned timestamp
+        so every replica stores an identical LWW cell — unsynchronized
+        node clocks never decide a merge, and block checksums agree
+        immediately after a healthy broadcast."""
+        idx = self.server.holder.index(index)
+        if idx is None:
+            raise ValueError(f"index {index!r} not found")
+        if call.name == "SetRowAttrs":
+            if len(call.pos_args) < 2:
+                raise ValueError("SetRowAttrs(field, row, attrs...) needs 2 args")
+            fname = call.pos_args[0]
+            row = call.pos_args[1]
+            f = idx.field(fname)
+            if f is None:
+                raise ValueError(f"field {fname!r} not found")
+            id_ = (
+                self.translate_row_key(index, fname, row)
+                if isinstance(row, str)
+                else row
+            )
+            payload = {"index": index, "field": fname, "id": id_}
+        else:
+            col = call.pos_args[0] if call.pos_args else None
+            if col is None:
+                raise ValueError("SetColumnAttrs(col, attrs...) needs a column")
+            id_ = (
+                self.translate_column_key(index, col)
+                if isinstance(col, str)
+                else col
+            )
+            payload = {"index": index, "id": id_}
+        payload["attrs"] = dict(call.args)
+        payload["ts"] = time.time()
+        for n in self.nodes:
+            if not self._probe_alive(n):
+                continue
+            if n.id == self.me.id:
+                self._apply_attr_write(payload)
+            else:
+                self.client.set_attrs(n.uri, payload)
+        return None
+
+    def _apply_attr_write(self, payload: dict) -> None:
+        idx = self.server.holder.index(payload["index"])
+        if idx is None:
+            return
+        if payload.get("field"):
+            f = idx.field(payload["field"])
+            if f is None:
+                return
+            store = f.row_attrs
+        else:
+            store = idx.column_attrs
+        store.set_attrs(int(payload["id"]), payload["attrs"], ts=payload["ts"])
 
     # -------------------------------------------------------------- imports
     def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
@@ -626,7 +684,34 @@ class Cluster:
                                 )
                             except PeerError:
                                 continue
+            self._sync_attr_stores(idx_name, idx)
         self._tail_translations()
+
+    def _sync_attr_stores(self, idx_name: str, idx) -> None:
+        """Block-checksum diff of the column/row attr stores against all
+        peers (reference: holderSyncer attr block sync). Attr writes
+        broadcast cluster-wide with one coordinator timestamp, so this
+        only repairs nodes that missed a broadcast while down; the merge
+        is key-wise last-writer-wins with tombstones (AttrStore
+        .merge_block), so missed deletes propagate instead of being
+        resurrected."""
+        stores: list[tuple[str | None, Any]] = [(None, idx.column_attrs)]
+        stores += [(f_name, f.row_attrs) for f_name, f in list(idx.fields.items())]
+        for peer in self._peers():
+            try:
+                for field_name, store in stores:
+                    theirs = self.client.attr_blocks(peer.uri, idx_name, field_name)
+                    mine = {b: c.hex() for b, c in store.block_checksums()}
+                    for block, checksum in theirs.items():
+                        if mine.get(block) == checksum:
+                            continue
+                        data = self.client.attr_block_data(
+                            peer.uri, idx_name, field_name, block
+                        )
+                        if data:
+                            store.merge_block(data)
+            except PeerError:
+                continue  # peer unreachable; skip its remaining stores
 
     def _sync_fragment(self, index, field, view, shard, frag, peer: Node) -> None:
         theirs = self.client.fragment_blocks(peer.uri, index, field, view, shard)
@@ -698,12 +783,22 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/import-value/([^/]+)/([^/]+)$"),
             ): self._h_import_values,
+            ("POST", re.compile(r"^/internal/attrs/set$")): self._h_attr_set,
+            ("GET", re.compile(r"^/internal/attrs/blocks$")): self._h_attr_blocks,
+            (
+                "GET",
+                re.compile(r"^/internal/attrs/block/data$"),
+            ): self._h_attr_block_data,
             ("GET", re.compile(r"^/internal/translate/data$")): self._h_translate_data,
             (
                 "POST",
                 re.compile(r"^/internal/translate/create$"),
             ): self._h_translate_create,
             ("POST", re.compile(r"^/internal/sync$")): self._h_sync,
+            (
+                "POST",
+                re.compile(r"^/internal/schema/apply$"),
+            ): self._h_schema_apply,
             (
                 "POST",
                 re.compile(r"^/internal/schema/delete$"),
@@ -762,6 +857,10 @@ class Cluster:
         handler.end_headers()
         handler.wfile.write(data)
 
+    def _h_schema_apply(self, handler) -> None:
+        self.server.api.apply_schema(handler._json_body(), validate=False)
+        handler._json({"success": True})
+
     def _h_schema_delete(self, handler) -> None:
         body = handler._json_body()
         index, field = body.get("index"), body.get("field")
@@ -813,6 +912,36 @@ class Cluster:
         self.server.api.import_values(index, field, handler._json_body())
         handler._json({"success": True})
 
+    def _attr_store_from_params(self, handler):
+        """Resolve the attr store named by index= [+ field=] params:
+        the index's column-attr store, or a field's row-attr store."""
+        p = handler.query_params
+        idx = self.server.holder.index(p["index"][0])
+        if idx is None:
+            return None
+        field = p.get("field", [None])[0]
+        if field is None:
+            return idx.column_attrs
+        f = idx.field(field)
+        return f.row_attrs if f else None
+
+    def _h_attr_set(self, handler) -> None:
+        self._apply_attr_write(handler._json_body())
+        handler._json({"success": True})
+
+    def _h_attr_blocks(self, handler) -> None:
+        store = self._attr_store_from_params(handler)
+        blocks = store.block_checksums() if store else []
+        handler._json(
+            {"blocks": [{"block": b, "checksum": c.hex()} for b, c in blocks]}
+        )
+
+    def _h_attr_block_data(self, handler) -> None:
+        store = self._attr_store_from_params(handler)
+        block = int(handler.query_params["block"][0])
+        data = store.block_data(block) if store else {}
+        handler._json({"attrs": {str(k): v for k, v in data.items()}})
+
     def _h_translate_data(self, handler) -> None:
         p = handler.query_params
         index = p["index"][0]
@@ -828,13 +957,26 @@ class Cluster:
         handler._json({"entries": [{"k": k, "id": i} for k, i in entries]})
 
     def _h_translate_create(self, handler) -> None:
-        body = handler._json_body()
+        """Batch key→ID translation on the primary. JSON body or a
+        protobuf TranslateKeysRequest (returns TranslateKeysResponse)."""
+        from pilosa_tpu import encoding
+
+        proto = handler._proto_body()
+        if proto:
+            body = encoding.protoser.translate_keys_request_from_bytes(
+                handler._body()
+            )
+        else:
+            body = handler._json_body()
         idx = self.server.holder.index(body["index"])
         store = (
             idx.field(body["field"]).row_keys if body.get("field") else idx.column_keys
         )
         ids = store.translate_keys(body["keys"], create=body.get("create", True))
-        handler._json({"ids": ids})
+        if proto:
+            handler._proto(encoding.protoser.translate_keys_response_to_bytes(ids))
+        else:
+            handler._json({"ids": ids})
 
 
 def serialize_empty() -> bytes:

@@ -10,6 +10,7 @@ here so transport layers stay thin.
 from __future__ import annotations
 
 import io
+import re
 from datetime import datetime
 from typing import Any
 
@@ -27,6 +28,20 @@ from pilosa_tpu.core import (
 )
 from pilosa_tpu.executor import ExecutionError, Executor, RowResult
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+
+# index/field naming rule (reference: validateName in pilosa.go — lowercase
+# start, then lowercase/digit/underscore/dash, max 64 chars)
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
+
+
+def validate_name(name: str, what: str = "name") -> str:
+    if not _NAME_RE.fullmatch(name):
+        raise ExecutionError(
+            f"invalid {what} {name!r}: must match [a-z][a-z0-9_-]* "
+            "and be at most 64 characters"
+        )
+    return name
 
 
 def field_options_from_json(opts: dict) -> FieldOptions:
@@ -54,6 +69,7 @@ class API:
 
     # ------------------------------------------------------------- schema
     def create_index(self, name: str, options: dict | None = None) -> Index:
+        validate_name(name, "index name")
         opts = options or {}
         idx = self.holder.create_index(
             name,
@@ -68,6 +84,7 @@ class API:
         self.holder.delete_index(name)
 
     def create_field(self, index: str, name: str, options: dict | None = None) -> Field:
+        validate_name(name, "field name")
         idx = self._index(index)
         return idx.create_field(name, field_options_from_json(options or {}))
 
@@ -77,10 +94,15 @@ class API:
     def schema(self) -> dict:
         return {"indexes": self.holder.schema()}
 
-    def apply_schema(self, schema: dict) -> None:
+    def apply_schema(self, schema: dict, validate: bool = True) -> None:
         """Idempotently create everything in a schema dump (reference:
-        api.ApplySchema)."""
+        api.ApplySchema). ``validate=False`` is for cluster schema sync:
+        replication must accept names that predate (or bypass) the
+        create-time validation rule, or a node could fail to join against
+        existing data."""
         for idx_def in schema.get("indexes", []):
+            if validate:
+                validate_name(idx_def["name"], "index name")
             opts = idx_def.get("options", {})
             idx = self.holder.create_index_if_not_exists(
                 idx_def["name"],
@@ -90,6 +112,8 @@ class API:
                 ),
             )
             for f_def in idx_def.get("fields", []):
+                if validate:
+                    validate_name(f_def["name"], "field name")
                 if idx.field(f_def["name"]) is None:
                     idx.create_field(
                         f_def["name"], field_options_from_json(f_def.get("options", {}))

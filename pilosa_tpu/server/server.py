@@ -32,8 +32,13 @@ class Server:
         self._closed = False
 
     def open(self) -> None:
-        """holder load → cluster join → HTTP up → background loops
-        (reference: Server.Open)."""
+        """holder load → HTTP up → cluster join → background loops
+        (reference: Server.Open). The listener must serve BEFORE the
+        cluster join: socketserver binds in the constructor, so a peer
+        that probed a bound-but-not-serving node would hang in the accept
+        backlog for the full client timeout instead of getting an instant
+        connection-refused — concurrent cold starts then stack 30s
+        timeouts on each other."""
         self.holder.open()
         self.http = HTTPServer(
             (self.config.host, self.config.port), self.api, stats=self.stats
@@ -45,8 +50,9 @@ class Server:
 
             self.cluster = Cluster(self)
             self.api.cluster = self.cluster
-            self.cluster.open()
         self.http.serve_background()
+        if self.cluster is not None:
+            self.cluster.open()
         self._schedule_anti_entropy()
         from pilosa_tpu.server.diagnostics import DiagnosticsCollector
 

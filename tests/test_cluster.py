@@ -184,6 +184,142 @@ def test_replication_and_anti_entropy(tmp_path):
         shutdown(servers)
 
 
+def test_translate_keys_protobuf_route(tmp_path):
+    import urllib.request
+
+    from pilosa_tpu import encoding
+
+    if not encoding.AVAILABLE:
+        pytest.skip("no protobuf runtime")
+    from pilosa_tpu.encoding import protoser
+
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/k", {"options": {"keys": True}})
+        call(ports[0], "POST", "/index/k/field/f", {"options": {"keys": True}})
+        call(ports[0], "POST", "/index/k/query", b'Set("a", f="x") Set("b", f="x")')
+        # batch column-key translation over protobuf, against each node
+        # (non-primaries answer from their tailed copy or the primary)
+        primary = servers[0].cluster._translate_primary()
+        req = urllib.request.Request(
+            f"{primary.uri}/internal/translate/create",
+            data=protoser.translate_keys_request_to_bytes("k", ["a", "b"]),
+            method="POST",
+            headers={"Content-Type": encoding.CONTENT_TYPE},
+        )
+        with urllib.request.urlopen(req) as resp:
+            ids = protoser.translate_keys_response_from_bytes(resp.read())
+        assert len(ids) == 2 and len(set(ids)) == 2
+        # same keys over JSON resolve identically
+        jresp = call(
+            ports[0] if primary.uri.endswith(str(ports[0])) else ports[1],
+            "POST", "/internal/translate/create",
+            {"index": "k", "keys": ["a", "b"]},
+        )
+        assert jresp["ids"] == ids
+    finally:
+        shutdown(servers)
+
+
+def test_attr_store_anti_entropy(tmp_path):
+    """A node that misses an attr broadcast is repaired by the attr-store
+    block sync (reference: holderSyncer attr block diff)."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/query",
+             b'Set(1, f=1) SetRowAttrs(f, 1, color="red") SetColumnAttrs(1, city="nyc")')
+        # simulate a missed broadcast: wipe node 1's local copies
+        idx1 = servers[1].holder.index("i")
+        idx1.field("f").row_attrs._cells.clear()
+        idx1.column_attrs._cells.clear()
+        servers[1].cluster.sync_holder()
+        assert idx1.field("f").row_attrs.attrs(1) == {"color": "red"}
+        assert idx1.column_attrs.attrs(1) == {"city": "nyc"}
+    finally:
+        shutdown(servers)
+
+
+def test_attr_broadcast_single_timestamp(tmp_path):
+    """A broadcast attr write stamps the SAME coordinator timestamp on
+    every node, so LWW never compares unsynchronized clocks and block
+    checksums agree immediately."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/query", b'SetRowAttrs(f, 1, color="red")')
+        cells = [
+            s.holder.index("i").field("f").row_attrs._cells[1]["color"]
+            for s in servers
+        ]
+        assert cells[0] == cells[1] == cells[2]
+        sums = [
+            s.holder.index("i").field("f").row_attrs.block_checksums()
+            for s in servers
+        ]
+        assert sums[0] == sums[1] == sums[2]
+    finally:
+        shutdown(servers)
+
+
+def test_attr_delete_not_resurrected_by_sync(tmp_path):
+    """A node holding a stale attr (it missed the delete broadcast) must
+    not resurrect it cluster-wide: the LWW tombstone wins the merge."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/query", b'SetRowAttrs(f, 1, color="red")')
+        store0 = servers[0].holder.index("i").field("f").row_attrs
+        store1 = servers[1].holder.index("i").field("f").row_attrs
+        assert store1.attrs(1) == {"color": "red"}
+        # node 1 misses the delete: apply it only on node 0
+        store0.set_attrs(1, {"color": None})
+        # both directions of anti-entropy: neither resurrects the value
+        servers[0].cluster.sync_holder()
+        assert store0.attrs(1) == {}
+        servers[1].cluster.sync_holder()
+        assert store1.attrs(1) == {}
+        servers[0].cluster.sync_holder()
+        assert store0.attrs(1) == {}
+    finally:
+        shutdown(servers)
+
+
+def test_translate_lookup_only_never_allocates(tmp_path):
+    import urllib.request
+
+    from pilosa_tpu import encoding
+
+    if not encoding.AVAILABLE:
+        pytest.skip("no protobuf runtime")
+    from pilosa_tpu.encoding import protoser
+
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/k", {"options": {"keys": True}})
+        primary = servers[0].cluster._translate_primary()
+        req = urllib.request.Request(
+            f"{primary.uri}/internal/translate/create",
+            data=protoser.translate_keys_request_to_bytes(
+                "k", ["ghost"], create=False
+            ),
+            method="POST",
+            headers={"Content-Type": encoding.CONTENT_TYPE},
+        )
+        with urllib.request.urlopen(req) as resp:
+            ids = protoser.translate_keys_response_from_bytes(resp.read())
+        assert ids == [0]  # unknown key, not allocated
+        # the lookup really did not create the key
+        for s in servers:
+            idx = s.holder.index("k")
+            assert idx is None or idx.column_keys.translate_key("ghost", create=False) is None
+    finally:
+        shutdown(servers)
+
+
 def test_node_down_degraded_and_catchup(tmp_path):
     servers, ports, seeds = make_cluster(tmp_path, n=3, replica_n=2, start={0, 1})
     try:

@@ -40,7 +40,9 @@ def call(port, method, path, body=None, timeout=120):
         return json.loads(resp.read() or b"{}")
 
 
-def wait_ready(port, deadline=120.0):
+def wait_ready(port, deadline=360.0):
+    # generous: 3 JAX subprocesses importing concurrently on a 1-CPU CI
+    # box take >100s wall before the first one binds its socket
     t0 = time.time()
     while time.time() - t0 < deadline:
         try:
@@ -74,9 +76,9 @@ def procs(tmp_path):
         ]
         if i == 0:
             args.append("--coordinator")
+        log = open(tmp_path / f"n{i}.log", "w")
         running.append(subprocess.Popen(
-            args, env=env,
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            args, env=env, stdout=log, stderr=subprocess.STDOUT,
         ))
     try:
         for p in ports:

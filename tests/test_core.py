@@ -302,3 +302,30 @@ def test_rows_containing():
     frag.set_bit(3, 42)
     frag.set_bit(5, 41)
     assert frag.rows_containing(42) == [0, 3]
+
+
+def test_attrstore_persistence_and_v1_migration(tmp_path):
+    import json
+
+    from pilosa_tpu.core.attrstore import AttrStore
+
+    # v2 round trip, tombstones survive reopen
+    path = str(tmp_path / "attrs.json")
+    s = AttrStore(path)
+    s.set_attrs(1, {"color": "red", "n": 3})
+    s.set_attrs(1, {"color": None})
+    s2 = AttrStore(path)
+    s2.open()
+    assert s2.attrs(1) == {"n": 3}
+    # the tombstone still wins a merge of the stale value
+    stale = {1: {"color": ["red", 0.0]}}
+    s2.merge_block(stale)
+    assert s2.attrs(1) == {"n": 3}
+
+    # legacy v1 file (plain id → attrs) migrates on open
+    v1_path = str(tmp_path / "v1.json")
+    with open(v1_path, "w") as f:
+        json.dump({"7": {"city": "nyc"}}, f)
+    old = AttrStore(v1_path)
+    old.open()
+    assert old.attrs(7) == {"city": "nyc"}

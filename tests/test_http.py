@@ -4,6 +4,7 @@ Mirrors http/handler_test.go: real sockets, JSON bodies, error codes."""
 
 import json
 import urllib.error
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -46,6 +47,17 @@ def test_full_http_workflow(srv):
     assert schema["indexes"][0]["fields"][0]["name"] == "f"
     idx = call(srv, "GET", "/index/i")
     assert idx["name"] == "i"
+
+
+def test_invalid_names_rejected(srv):
+    for bad in ("UPPER", "1leading", "has space", "x" * 65, "<script>"):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(srv, "POST", f"/index/{urllib.parse.quote(bad)}", {})
+        assert e.value.code == 400
+    call(srv, "POST", "/index/ok-name_2", {})
+    with pytest.raises(urllib.error.HTTPError) as e:
+        call(srv, "POST", "/index/ok-name_2/field/Bad", {})
+    assert e.value.code == 400
 
 
 def test_console_served_at_root(srv):
