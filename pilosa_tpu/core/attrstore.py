@@ -152,7 +152,19 @@ class AttrStore:
                 mine = self._cells.setdefault(int(id_), {})
                 for k, cell in cells.items():
                     value, ts = cell[0], cell[1]
-                    if k not in mine or mine[k][1] < ts:
+                    if k not in mine:
+                        mine[k] = [value, ts]
+                        continue
+                    # newer ts wins; equal ts (e.g. two divergent
+                    # v1-migrated files, both stamped 0.0) tie-breaks on
+                    # the serialized value so every replica converges to
+                    # the same winner regardless of merge order
+                    my_val, my_ts = mine[k][0], mine[k][1]
+                    if my_ts < ts or (
+                        my_ts == ts
+                        and json.dumps(value, sort_keys=True)
+                        > json.dumps(my_val, sort_keys=True)
+                    ):
                         mine[k] = [value, ts]
             self._prune_tombstones()
             self._persist()

@@ -45,6 +45,31 @@ from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
+def apply_options(idx: "Index", call: "Call", res: Any) -> Any:
+    """Apply an Options() wrapper's result-shaping args (reference:
+    QueryRequest ColumnAttrs/ExcludeColumns/ExcludeRowAttrs). Shared by
+    the local executor and the cluster coordinator (which re-applies
+    after merging per-node partials)."""
+    if isinstance(res, RowResult):
+        if call.arg("excludeColumns"):
+            res.exclude_columns = True
+        if call.arg("excludeRowAttrs"):
+            res.exclude_row_attrs = True
+        if call.arg("columnAttrs"):
+            sets = []
+            for col in res.columns().tolist():
+                attrs = idx.column_attrs.attrs(int(col))
+                if attrs:
+                    entry: dict = {"id": int(col), "attrs": attrs}
+                    if idx.options.keys:
+                        key = idx.column_keys.translate_id(int(col))
+                        if key is not None:
+                            entry["key"] = key
+                    sets.append(entry)
+            res.column_attr_sets = sets
+    return res
+
+
 BITMAP_CALLS = {
     "Row",
     "Range",
@@ -107,7 +132,8 @@ class Executor:
             if len(call.children) != 1:
                 raise ExecutionError("Options() takes exactly one call")
             opt_shards = call.arg("shards", shards)
-            return self._execute_call(idx, call.children[0], opt_shards)
+            res = self._execute_call(idx, call.children[0], opt_shards)
+            return apply_options(idx, call, res)
         if name in WRITE_CALLS:
             return self._execute_write(idx, call)
         shard_list = self._shards(idx, shards)
@@ -118,6 +144,7 @@ class Executor:
                     {s: words[i] for i, s in enumerate(shard_list)}
                 )
                 self._attach_keys(idx, res)
+                self._attach_row_attrs(idx, call, res)
                 return res
             if name == "Count":
                 if len(call.children) != 1:
@@ -173,6 +200,29 @@ class Executor:
                 raise ExecutionError(f"index {idx.name!r} does not use string keys")
             return idx.column_keys.translate_key(col, create=create)
         raise ExecutionError(f"bad column value {col!r}")
+
+    def _attach_row_attrs(self, idx: Index, call: Call, res: RowResult) -> None:
+        """Direct Row(field=row) results carry the row's attributes
+        (reference: QueryResult Row.Attrs)."""
+        if call.name != "Row" or call.condition() is not None:
+            return
+        fa = call.field_arg()
+        if fa is None:
+            return
+        field = idx.field(fa[0])
+        if field is None:
+            return
+        row_id = fa[1]
+        if isinstance(row_id, str):
+            if not field.options.keys:
+                return
+            row_id = field.row_keys.translate_key(row_id, create=False)
+            if row_id is None:
+                return
+        if isinstance(row_id, bool):
+            row_id = int(row_id)
+        if isinstance(row_id, int):
+            res.attrs = field.row_attrs.attrs(row_id)
 
     def _attach_keys(self, idx: Index, res: RowResult) -> None:
         if idx.options.keys:

@@ -22,6 +22,11 @@ class RowResult:
         self.segments = segments or {}
         self.attrs: dict = {}
         self.keys: list[str] | None = None
+        # Options() wrapper flags (reference: QueryRequest ExcludeColumns/
+        # ExcludeRowAttrs; ColumnAttrSets when columnAttrs=true)
+        self.exclude_columns = False
+        self.exclude_row_attrs = False
+        self.column_attr_sets: list[dict] | None = None
 
     def count(self) -> int:
         return sum(words_count(np.asarray(w)) for w in self.segments.values())
@@ -41,6 +46,9 @@ class RowResult:
         out: dict = {"columns": self.columns().tolist()}
         if self.keys is not None:
             out = {"keys": self.keys}
-        if self.attrs:
+        if self.exclude_columns:
+            out.pop("columns", None)
+            out.pop("keys", None)
+        if self.attrs and not self.exclude_row_attrs:
             out["attrs"] = self.attrs
         return out

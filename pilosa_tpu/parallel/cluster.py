@@ -28,7 +28,7 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu.executor import RowResult
-from pilosa_tpu.executor.executor import WRITE_CALLS
+from pilosa_tpu.executor.executor import WRITE_CALLS, apply_options
 from pilosa_tpu.parallel.client import (
     InternalClient,
     PeerError,
@@ -83,16 +83,37 @@ class Cluster:
     def nodes(self) -> list[Node]:
         return self.topology.nodes
 
-    def open(self) -> None:
+    def attach(self) -> None:
+        """Mount routes and routers BEFORE the listener starts serving:
+        a request arriving during the join window must hit the cluster
+        router (which rejects with 503 while STARTING), never the local
+        default router; peers probing /internal/* must not see 404."""
         self._mount_internal_routes()
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
         self.server.http.broadcast_schema = self.broadcast_schema
         self.server.http.broadcast_deletion = self.broadcast_deletion
+
+    def join(self) -> None:
+        """Heartbeat + pull recovery, then STARTING → NORMAL (reference:
+        cluster state negotiation in Server.Open). Runs after the
+        listener is up so concurrent cold starts don't stack probe
+        timeouts on bound-but-not-serving sockets."""
         self._heartbeat_once()
         self._recover_on_join()
         self.state = STATE_NORMAL
         self._schedule_heartbeat()
+
+    def open(self) -> None:
+        self.attach()
+        self.join()
+
+    def _check_ready(self) -> None:
+        self._check_not_removed()
+        if self.state == STATE_STARTING:
+            raise ShardUnavailableError(
+                "cluster state STARTING; retry when the node has joined"
+            )
 
     def close(self) -> None:
         self._closed = True
@@ -327,7 +348,7 @@ class Cluster:
 
     # -------------------------------------------------------------- queries
     def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
-        self._check_not_removed()
+        self._check_ready()
         calls = parse(pql)
         results = []
         for call in calls:
@@ -335,9 +356,21 @@ class Cluster:
                 results.append(self._route_write(index, call))
             else:
                 results.append(self._route_read(index, call, shards))
-        return {"results": [self.server.api._result_json(r) for r in results]}
+        return self.server.api.build_response(results)
 
     def _route_read(self, index: str, call: Call, shards: list[int] | None) -> Any:
+        # scatter only the inner call of an Options() wrapper: result
+        # shaping (columnAttrs/exclude*) is re-derived at the coordinator
+        # after the merge, so running it on every node is pure waste
+        wrapper: Call | None = None
+        if call.name == "Options":
+            if len(call.children) != 1:
+                raise ValueError("Options() takes exactly one call")
+            wrapper = call
+            opt_shards = wrapper.arg("shards")
+            if opt_shards is not None:
+                shards = list(opt_shards)
+            call = call.children[0]
         call = self._translate_read_keys(index, call)
         if call.name == "IncludesColumn":
             # only the column's own shard can answer — one RPC, not a fan-out
@@ -378,6 +411,14 @@ class Cluster:
         result = reduce_results(call, partials)
         if isinstance(result, RowResult):
             self._attach_column_keys(index, result)
+            # attrs/options don't survive the segment wire format; attr
+            # stores replicate cluster-wide, so re-derive at the
+            # coordinator (reference: executor reduce attaches attrs)
+            idx = self.server.holder.index(index)
+            if idx is not None:
+                self.server.api.executor._attach_row_attrs(idx, call, result)
+                if wrapper is not None:
+                    apply_options(idx, wrapper, result)
         return result
 
     def _translate_read_keys(self, index: str, call: Call) -> Call:
@@ -573,7 +614,7 @@ class Cluster:
 
     # -------------------------------------------------------------- imports
     def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
-        self._check_not_removed()
+        self._check_ready()
         api = self.server.api
         idx = self.server.holder.index(index)
         if idx is None:

@@ -124,7 +124,22 @@ class API:
         self, index: str, pql: str, shards: list[int] | None = None
     ) -> dict:
         results = self.executor.execute(index, pql, shards=shards)
-        return {"results": [self._result_json(r) for r in results]}
+        return self.build_response(results)
+
+    def build_response(self, results: list[Any]) -> dict:
+        """Assemble the QueryResponse dict; Options(columnAttrs=true)
+        results contribute response-level columnAttrs sets (reference:
+        QueryResponse.ColumnAttrSets)."""
+        resp: dict = {"results": [self._result_json(r) for r in results]}
+        col_sets = [
+            s
+            for r in results
+            if isinstance(r, RowResult) and r.column_attr_sets
+            for s in r.column_attr_sets
+        ]
+        if col_sets:
+            resp["columnAttrs"] = col_sets
+        return resp
 
     def _result_json(self, r: Any) -> Any:
         if isinstance(r, RowResult):

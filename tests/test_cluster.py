@@ -241,6 +241,67 @@ def test_attr_store_anti_entropy(tmp_path):
         shutdown(servers)
 
 
+def test_starting_state_rejects_queries(tmp_path):
+    """During the join window (attach done, join pending) the data plane
+    answers 503 instead of silently routing local-only."""
+    import urllib.error
+
+    ports = free_ports(1)
+    cfg = Config(
+        bind=f"127.0.0.1:{ports[0]}",
+        data_dir=str(tmp_path / "n0"),
+        seeds=[f"http://127.0.0.1:{ports[0]}"],
+        anti_entropy_interval=0,
+        coordinator=True,
+    )
+    from pilosa_tpu.server.server import Server as Srv
+
+    s = Srv(cfg)
+    # replicate Server.open up to (not including) cluster.join
+    s.holder.open()
+    from pilosa_tpu.server.http import HTTPServer
+
+    s.http = HTTPServer((s.config.host, s.config.port), s.api, stats=s.stats)
+    from pilosa_tpu.parallel.cluster import Cluster
+
+    s.cluster = Cluster(s)
+    s.api.cluster = s.cluster
+    s.cluster.attach()
+    s.http.serve_background()
+    try:
+        assert call(ports[0], "GET", "/status")["state"] == "STARTING"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert e.value.code == 503
+        s.cluster.join()
+        assert call(ports[0], "GET", "/status")["state"] == "NORMAL"
+    finally:
+        s.close()
+
+
+def test_row_attrs_and_column_attrs_cluster(tmp_path):
+    """Row attrs and Options(columnAttrs) survive the scatter-gather
+    path: the coordinator re-derives them after merging segments."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        col = 3 * SHARD_WIDTH + 5
+        call(ports[0], "POST", "/index/i/query",
+             f'Set(1, f=1) Set({col}, f=1) SetRowAttrs(f, 1, team="sre") '
+             f'SetColumnAttrs({col}, dc="ord")'.encode())
+        for p in ports:
+            r = call(p, "POST", "/index/i/query", b"Row(f=1)")["results"][0]
+            assert r["columns"] == [1, col]
+            assert r["attrs"] == {"team": "sre"}
+            resp = call(p, "POST", "/index/i/query",
+                        b"Options(Row(f=1), columnAttrs=true, excludeRowAttrs=true)")
+            assert resp["columnAttrs"] == [{"id": col, "attrs": {"dc": "ord"}}]
+            assert "attrs" not in resp["results"][0]
+    finally:
+        shutdown(servers)
+
+
 def test_attr_broadcast_single_timestamp(tmp_path):
     """A broadcast attr write stamps the SAME coordinator timestamp on
     every node, so LWW never compares unsynchronized clocks and block

@@ -329,3 +329,18 @@ def test_attrstore_persistence_and_v1_migration(tmp_path):
     old = AttrStore(v1_path)
     old.open()
     assert old.attrs(7) == {"city": "nyc"}
+
+
+def test_attrstore_equal_ts_tie_break_converges(tmp_path):
+    """Divergent replicas with equal timestamps (e.g. two v1-migrated
+    files, both stamped ts=0) converge to the same winner in either
+    merge order."""
+    from pilosa_tpu.core.attrstore import AttrStore
+
+    a, b = AttrStore(None), AttrStore(None)
+    a.set_attrs(7, {"city": "ams"}, ts=0.0)
+    b.set_attrs(7, {"city": "nyc"}, ts=0.0)
+    a.merge_block(b.block_data(0))
+    b.merge_block({7: {"city": ["ams", 0.0]}})
+    assert a.attrs(7) == b.attrs(7) == {"city": "nyc"}  # "nyc" > "ams"
+    assert a.block_checksums() == b.block_checksums()

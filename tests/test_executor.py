@@ -188,6 +188,25 @@ def test_time_field_range_query(env):
     assert set(r.columns().tolist()) == {1, 2, 3}
 
 
+def test_row_attrs_and_options_shaping(env):
+    """Row() results carry row attrs; Options() shapes the result
+    (reference: QueryResult Row.Attrs, QueryRequest Exclude*/ColumnAttrs)."""
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, 'Set(1, f=1) Set(2, f=1) SetRowAttrs(f, 1, color="red")')
+    (r,) = q(e, "Row(f=1)")
+    assert r.attrs == {"color": "red"}
+    assert r.to_json() == {"columns": [1, 2], "attrs": {"color": "red"}}
+    (r,) = q(e, "Options(Row(f=1), excludeColumns=true)")
+    assert r.to_json() == {"attrs": {"color": "red"}}
+    (r,) = q(e, "Options(Row(f=1), excludeRowAttrs=true)")
+    assert r.to_json() == {"columns": [1, 2]}
+    # columnAttrs=true: response-level column attr sets
+    q(e, 'SetColumnAttrs(2, city="nyc")')
+    (r,) = q(e, "Options(Row(f=1), columnAttrs=true)")
+    assert r.column_attr_sets == [{"id": 2, "attrs": {"city": "nyc"}}]
+
+
 def test_time_field_quoted_timestamps(env):
     """Quoted ISO timestamps in Set() and from=/to= behave like bare
     literals (both forms are valid client PQL)."""
