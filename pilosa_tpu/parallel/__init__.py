@@ -7,7 +7,9 @@ parallelism live here:
   partitioning, replica chains, HTTP scatter-gather, anti-entropy;
 - ``mesh``: chip-level scale-out — jax.sharding.Mesh execution of whole
   query batches with psum reductions over ICI (replaces the reference's
-  per-node goroutine hot loop AND its HTTP reduce for intra-pod shards).
+  per-node goroutine hot loop AND its HTTP reduce for intra-pod shards);
+- ``multihost``: jax.distributed process-group init + DCN/ICI-aware mesh
+  construction (words axis pinned within a host's ICI domain).
 """
 
 from pilosa_tpu.parallel.topology import (

@@ -352,8 +352,15 @@ class Cluster:
         calls = parse(pql)
         results = []
         for call in calls:
-            if call.name in WRITE_CALLS:
-                results.append(self._route_write(index, call))
+            # classify on the inner call: Options(Set(...)) must take the
+            # write path (replica fan-out), not the read scatter
+            inner = (
+                call.children[0]
+                if call.name == "Options" and len(call.children) == 1
+                else call
+            )
+            if inner.name in WRITE_CALLS:
+                results.append(self._route_write(index, inner))
             else:
                 results.append(self._route_read(index, call, shards))
         return self.server.api.build_response(results)

@@ -1,0 +1,102 @@
+"""Multi-host mesh construction and process-group initialization.
+
+Reference mapping: the reference scales out with HTTP scatter-gather over
+memberlist-discovered nodes (cluster.go, gossip/, http/client.go). The
+TPU-native equivalent keeps THAT layer for ingest/control (parallel/
+cluster.py over DCN), but runs the data plane as ONE jit program over a
+multi-host ``jax.sharding.Mesh``: every query's reduction is an XLA
+collective instead of an HTTP merge.
+
+Axis placement follows the ICI/DCN split ("How to Scale Your Model"
+recipe): the **words** axis (intra-row bit dimension, the
+sequence-parallel analogue) must ride ICI — its psum runs on every count
+— so it is laid out within a host's chips; the **shards** axis (data
+parallelism over disjoint column ranges) is elementwise except for the
+final scalar reduce, so it can safely span hosts over DCN.
+
+Usage on each host of a pod slice (or CPU fleet):
+
+    from pilosa_tpu.parallel import multihost
+    multihost.init_distributed(coordinator_address="host0:8476",
+                               num_processes=4, process_id=this_host)
+    mesh = multihost.make_multihost_mesh(words_axis=4)
+    engine = MeshQueryEngine(mesh)   # same engine as single-host
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from pilosa_tpu.parallel.mesh import AXIS_SHARDS, AXIS_WORDS
+
+
+def init_distributed(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the fixed JAX process group (reference: gossip join is the
+    membership analogue; here membership is static, the
+    ``jax.distributed`` model). No-op when already initialized or when
+    running single-process with no coordinator configured."""
+    import jax
+
+    if coordinator_address is None:
+        return
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    except RuntimeError as e:  # already initialized
+        if "already" not in str(e).lower():
+            raise
+
+
+def group_devices_by_process(devices) -> list[list]:
+    """Devices bucketed by owning process (host), each bucket in stable
+    id order. Pure function of (process_index, id) so it is unit-testable
+    without real multi-host hardware."""
+    buckets: dict[int, list] = {}
+    for d in devices:
+        buckets.setdefault(d.process_index, []).append(d)
+    return [
+        sorted(buckets[p], key=lambda d: d.id) for p in sorted(buckets)
+    ]
+
+
+def multihost_device_grid(devices, words_axis: int) -> np.ndarray:
+    """Arrange devices into a (shards, words) grid with the words axis
+    CONTAINED IN a single host's devices, so word-axis collectives ride
+    ICI and only the shards axis crosses DCN.
+
+    Requires every host to hold a multiple of ``words_axis`` devices.
+    """
+    hosts = group_devices_by_process(devices)
+    rows: list[list] = []
+    for host_devs in hosts:
+        if len(host_devs) % words_axis:
+            raise ValueError(
+                f"host with {len(host_devs)} devices not divisible by "
+                f"words_axis={words_axis}; word-axis collectives would "
+                "cross hosts (DCN) instead of ICI"
+            )
+        for i in range(0, len(host_devs), words_axis):
+            rows.append(host_devs[i : i + words_axis])
+    return np.array(rows, dtype=object)
+
+
+def make_multihost_mesh(words_axis: int = 1, devices=None):
+    """(shards × words) Mesh over every device of every host.
+
+    Single-host (or single-process CPU) this degenerates to
+    ``mesh.make_mesh``'s layout; multi-host it keeps each words-group
+    within one host.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = list(devices if devices is not None else jax.devices())
+    grid = multihost_device_grid(devices, words_axis)
+    return Mesh(grid, (AXIS_SHARDS, AXIS_WORDS))

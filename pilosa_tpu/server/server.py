@@ -50,9 +50,12 @@ class Server:
 
             self.cluster = Cluster(self)
             self.api.cluster = self.cluster
+            # routes/routers must be live before the first request or a
+            # client could be silently served local-only (and peers 404)
+            self.cluster.attach()
         self.http.serve_background()
         if self.cluster is not None:
-            self.cluster.open()
+            self.cluster.join()
         self._schedule_anti_entropy()
         from pilosa_tpu.server.diagnostics import DiagnosticsCollector
 

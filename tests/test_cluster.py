@@ -241,6 +241,22 @@ def test_attr_store_anti_entropy(tmp_path):
         shutdown(servers)
 
 
+def test_options_wrapped_write_reaches_replicas(tmp_path):
+    """Options(Set(...)) routes as a write (replica fan-out), not a
+    single-primary read scatter."""
+    servers, ports, _ = make_cluster(tmp_path, n=2, replica_n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        assert call(ports[0], "POST", "/index/i/query",
+                    b"Options(Set(5, f=1))")["results"] == [True]
+        for s in servers:
+            frag = s.holder.index("i").field("f").view("standard").fragment(0)
+            assert frag is not None and frag.contains(1, 5)
+    finally:
+        shutdown(servers)
+
+
 def test_starting_state_rejects_queries(tmp_path):
     """During the join window (attach done, join pending) the data plane
     answers 503 instead of silently routing local-only."""
