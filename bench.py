@@ -20,13 +20,54 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 
 import numpy as np
 
+BACKEND_INIT_TIMEOUT_S = float(
+    os.environ.get("PILOSA_BENCH_INIT_TIMEOUT", "600")
+)
+
+
+def _backend_watchdog(done: threading.Event) -> None:
+    """A wedged accelerator transport can hang JAX backend init forever;
+    emit a diagnostic JSON line and exit nonzero instead of hanging the
+    driver."""
+    if done.wait(BACKEND_INIT_TIMEOUT_S):
+        return
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
+    n_columns = n_shards * SHARD_WIDTH
+    print(
+        json.dumps(
+            {
+                # same metric name as the success path so aggregators
+                # correlate the failure with the real series
+                "metric": f"intersect_count_qps_{n_columns // 10**9}B_columns",
+                "value": 0,
+                "unit": "qps",
+                "vs_baseline": 0,
+                "error": f"jax backend init exceeded {BACKEND_INIT_TIMEOUT_S:.0f}s"
+                " (accelerator transport unhealthy?)",
+            }
+        ),
+        flush=True,
+    )
+    os._exit(2)
+
 
 def main() -> None:
+    init_done = threading.Event()
+    threading.Thread(
+        target=_backend_watchdog, args=(init_done,), daemon=True
+    ).start()
+
     import jax
+
+    jax.devices()  # force backend init under the watchdog
+    init_done.set()
 
     from pilosa_tpu import ops
     from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD

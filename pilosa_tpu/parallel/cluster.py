@@ -104,10 +104,6 @@ class Cluster:
         self.state = STATE_NORMAL
         self._schedule_heartbeat()
 
-    def open(self) -> None:
-        self.attach()
-        self.join()
-
     def _check_ready(self) -> None:
         self._check_not_removed()
         if self.state == STATE_STARTING:
@@ -352,13 +348,12 @@ class Cluster:
         calls = parse(pql)
         results = []
         for call in calls:
-            # classify on the inner call: Options(Set(...)) must take the
-            # write path (replica fan-out), not the read scatter
-            inner = (
-                call.children[0]
-                if call.name == "Options" and len(call.children) == 1
-                else call
-            )
+            # classify on the innermost call: Options(Set(...)) — however
+            # deeply wrapped — must take the write path (replica
+            # fan-out), not the read scatter
+            inner = call
+            while inner.name == "Options" and len(inner.children) == 1:
+                inner = inner.children[0]
             if inner.name in WRITE_CALLS:
                 results.append(self._route_write(index, inner))
             else:

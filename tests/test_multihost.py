@@ -6,7 +6,7 @@ the multi-host layout and the 8-device virtual CPU platform exercises
 the degenerate single-process path end to end.
 """
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 import numpy as np
 import pytest
