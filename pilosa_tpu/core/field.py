@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 from dataclasses import asdict, dataclass, field as dc_field
 from datetime import datetime
 
@@ -73,6 +74,7 @@ class Field:
         self.path = path  # <index-path>/<field-name>
         self.options = options
         self.views: dict[str, View] = {}
+        self._create_lock = threading.Lock()
         # row attributes (reference: field.go rowAttrStore) and row-key
         # translation (reference: translate.go)
         self.row_attrs = AttrStore(
@@ -119,6 +121,13 @@ class Field:
         return self.views.get(name)
 
     def create_view_if_not_exists(self, name: str) -> View:
+        v = self.views.get(name)
+        if v is not None:
+            return v
+        with self._create_lock:
+            return self._create_view_locked(name)
+
+    def _create_view_locked(self, name: str) -> View:
         v = self.views.get(name)
         if v is None:
             view_path = os.path.join(self.path, "views", name) if self.path else None

@@ -11,6 +11,7 @@ index/field/view/fragment). Directory layout:
 from __future__ import annotations
 
 import os
+import threading
 import shutil
 
 from pilosa_tpu.core.index import Index, IndexOptions
@@ -20,6 +21,7 @@ class Holder:
     def __init__(self, path: str | None = None):
         self.path = path
         self.indexes: dict[str, Index] = {}
+        self._create_lock = threading.Lock()
 
     def open(self) -> None:
         if self.path is None:
@@ -45,6 +47,15 @@ class Holder:
         return self.create_index_if_not_exists(name, options)
 
     def create_index_if_not_exists(
+        self, name: str, options: IndexOptions | None = None
+    ) -> Index:
+        existing = self.indexes.get(name)
+        if existing is not None:
+            return existing
+        with self._create_lock:
+            return self._create_index_locked(name, options)
+
+    def _create_index_locked(
         self, name: str, options: IndexOptions | None = None
     ) -> Index:
         existing = self.indexes.get(name)

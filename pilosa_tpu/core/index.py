@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import shutil
 from dataclasses import asdict, dataclass
 
@@ -33,6 +34,7 @@ class Index:
         self.path = path  # <holder-path>/<index-name>
         self.options = options or IndexOptions()
         self.fields: dict[str, Field] = {}
+        self._create_lock = threading.Lock()
         # column attributes (reference: index.go columnAttrStore) and
         # column-key translation (reference: translate.go)
         self.column_attrs = AttrStore(
@@ -75,6 +77,15 @@ class Index:
         return self.create_field_if_not_exists(name, options)
 
     def create_field_if_not_exists(
+        self, name: str, options: FieldOptions | None = None
+    ) -> Field:
+        existing = self.fields.get(name)
+        if existing is not None:
+            return existing
+        with self._create_lock:
+            return self._create_field_locked(name, options)
+
+    def _create_field_locked(
         self, name: str, options: FieldOptions | None = None
     ) -> Field:
         existing = self.fields.get(name)

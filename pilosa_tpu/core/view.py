@@ -8,6 +8,7 @@ one "standard" view; a time field adds one view per calendar bucket; an int
 from __future__ import annotations
 
 import os
+import threading
 
 from pilosa_tpu.core.fragment import Fragment
 
@@ -32,27 +33,37 @@ class View:
         self.cache_type = cache_type
         self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
+        self._create_lock = threading.Lock()
 
     def fragment(self, shard: int) -> Fragment | None:
         return self.fragments.get(shard)
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
+        # double-checked under a lock: two concurrent writers racing this
+        # would otherwise build two Fragment objects over the same file
+        # (clashing snapshot tmp files, lost updates)
         frag = self.fragments.get(shard)
-        if frag is None:
-            frag_path = (
-                os.path.join(self.path, "fragments", str(shard)) if self.path else None
-            )
-            frag = Fragment(
-                frag_path,
-                self.index,
-                self.field,
-                self.name,
-                shard,
-                cache_type=self.cache_type,
-                cache_size=self.cache_size,
-            )
-            frag.open()
-            self.fragments[shard] = frag
+        if frag is not None:
+            return frag
+        with self._create_lock:
+            frag = self.fragments.get(shard)
+            if frag is None:
+                frag_path = (
+                    os.path.join(self.path, "fragments", str(shard))
+                    if self.path
+                    else None
+                )
+                frag = Fragment(
+                    frag_path,
+                    self.index,
+                    self.field,
+                    self.name,
+                    shard,
+                    cache_type=self.cache_type,
+                    cache_size=self.cache_size,
+                )
+                frag.open()
+                self.fragments[shard] = frag
         return frag
 
     def available_shards(self) -> set[int]:
