@@ -7,88 +7,90 @@ loops are at best comparable to numpy's vectorized popcount at this
 density). The TPU path is the framework's fused count_and kernel over the
 same packed representation, resident in HBM.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE final JSON line to stdout:
+    {"metric", "value", "unit", "vs_baseline", ...}
+
+Resilience (a wedged accelerator transport cost round 1 its only perf
+signal): the parent process retries backend init in FRESH child processes
+with bounded attempts, steps down the operand scale when a child dies
+(OOM/transport), and keeps the best completed stage so a late failure
+still yields a datapoint. Stage-by-stage progress goes to stderr.
 
 Scale knobs via env:
-    PILOSA_BENCH_SHARDS   (default 10240 → 10240·2^20 ≈ 10.7B columns,
-                           the BASELINE.md north-star scale; 2×1.34GB
-                           operands resident in HBM)
+    PILOSA_BENCH_SHARDS        (default 10240 → 10240·2^20 ≈ 10.7B columns,
+                                the BASELINE.md north-star scale; 2×1.34GB
+                                operands resident in HBM)
     PILOSA_BENCH_CPU_ITERS / PILOSA_BENCH_TPU_ITERS
+    PILOSA_BENCH_INIT_TIMEOUT  (per-child backend-init watchdog, s)
+    PILOSA_BENCH_TOTAL_BUDGET  (parent wall-clock budget, s)
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
+import sys
 import threading
 import time
 
-import numpy as np
-
-BACKEND_INIT_TIMEOUT_S = float(
-    os.environ.get("PILOSA_BENCH_INIT_TIMEOUT", "600")
-)
+INIT_TIMEOUT_S = float(os.environ.get("PILOSA_BENCH_INIT_TIMEOUT", "300"))
+TOTAL_BUDGET_S = float(os.environ.get("PILOSA_BENCH_TOTAL_BUDGET", "2700"))
+FULL_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
 
 
-def _backend_watchdog(done: threading.Event) -> None:
-    """A wedged accelerator transport can hang JAX backend init forever;
-    emit a diagnostic JSON line and exit nonzero instead of hanging the
-    driver."""
-    if done.wait(BACKEND_INIT_TIMEOUT_S):
-        return
-    from pilosa_tpu.shardwidth import SHARD_WIDTH
+def _stage(msg: dict) -> None:
+    print(json.dumps(msg), file=sys.stderr, flush=True)
 
-    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
-    n_columns = n_shards * SHARD_WIDTH
-    print(
-        json.dumps(
-            {
-                # same metric name as the success path so aggregators
-                # correlate the failure with the real series
-                "metric": f"intersect_count_qps_{n_columns // 10**9}B_columns",
-                "value": 0,
-                "unit": "qps",
-                "vs_baseline": 0,
-                "error": f"jax backend init exceeded {BACKEND_INIT_TIMEOUT_S:.0f}s"
-                " (accelerator transport unhealthy?)",
-            }
-        ),
-        flush=True,
+
+def _metric_name(n_columns: int) -> str:
+    scale = (
+        f"{n_columns // 10**9}B" if n_columns >= 10**9 else f"{n_columns // 10**6}M"
     )
-    os._exit(2)
+    return f"intersect_count_qps_{scale}_columns"
 
 
-def main() -> None:
+# --------------------------------------------------------------------- child
+def _child_main(n_shards: int) -> None:
+    """Measure at one scale; print one JSON result line on stdout."""
+    import numpy as np
+
     init_done = threading.Event()
-    threading.Thread(
-        target=_backend_watchdog, args=(init_done,), daemon=True
-    ).start()
 
+    def watchdog():
+        if init_done.wait(INIT_TIMEOUT_S):
+            return
+        _stage({"stage": "init_timeout", "seconds": INIT_TIMEOUT_S})
+        os._exit(3)  # parent treats rc=3 as "transport wedged — retry"
+
+    threading.Thread(target=watchdog, daemon=True).start()
+
+    t0 = time.perf_counter()
     import jax
 
-    jax.devices()  # force backend init under the watchdog
+    platform = jax.devices()[0].platform  # forces backend init under watchdog
     init_done.set()
+    _stage({"stage": "init_ok", "platform": platform,
+            "seconds": round(time.perf_counter() - t0, 1)})
 
     from pilosa_tpu import ops
     from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 
-    n_shards = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
     cpu_iters = int(os.environ.get("PILOSA_BENCH_CPU_ITERS", "5"))
     tpu_iters = int(os.environ.get("PILOSA_BENCH_TPU_ITERS", "50"))
     n_words = n_shards * WORDS_PER_SHARD
     n_columns = n_shards * SHARD_WIDTH
 
     rng = np.random.default_rng(7)
-    # ~3% density random rows, packed (uint32 words)
     a = rng.integers(0, 2**32, n_words, dtype=np.uint32)
     b = rng.integers(0, 2**32, n_words, dtype=np.uint32)
-    # thin them to realistic density (AND of random masks ≈ 3%)
+    # thin to realistic density (AND of random masks ≈ 3%)
     a &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
     a &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
     b &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
     b &= rng.integers(0, 2**32, n_words, dtype=np.uint32)
 
-    # ---------------- CPU baseline (the reference's single-node hot loop)
+    # ------------- CPU baseline (the reference's single-node hot loop)
     def cpu_query():
         return int(np.bitwise_count(a & b).sum())
 
@@ -98,35 +100,136 @@ def main() -> None:
         got = cpu_query()
     cpu_seconds = (time.perf_counter() - t0) / cpu_iters
     assert got == expect
+    _stage({"stage": "cpu_baseline", "qps": round(1 / cpu_seconds, 3)})
 
-    # ---------------- TPU path: fused AND+popcount, HBM-resident rows
+    # ------------- TPU path: fused AND+popcount, HBM-resident rows
     dev_a = jax.device_put(a)
     dev_b = jax.device_put(b)
 
-    @jax.jit
-    def tpu_query(x, y):
-        return ops.count_and(x, y)
-
+    tpu_query = jax.jit(ops.count_and)
     result = int(tpu_query(dev_a, dev_b))  # compile + warm
-    assert result == expect, f"TPU {result} != CPU {expect}"
+    assert result == expect, f"device {result} != CPU {expect}"
     t0 = time.perf_counter()
     for _ in range(tpu_iters):
         out = tpu_query(dev_a, dev_b)
     out.block_until_ready()
     tpu_seconds = (time.perf_counter() - t0) / tpu_iters
 
-    cpu_qps = 1.0 / cpu_seconds
-    tpu_qps = 1.0 / tpu_seconds
+    gbps = 2 * n_words * 4 / tpu_seconds / 1e9
     print(
         json.dumps(
             {
-                "metric": f"intersect_count_qps_{n_columns // 10**9}B_columns",
-                "value": round(tpu_qps, 2),
+                "metric": _metric_name(n_columns),
+                "value": round(1 / tpu_seconds, 2),
                 "unit": "qps",
-                "vs_baseline": round(tpu_qps / cpu_qps, 2),
+                "vs_baseline": round(cpu_seconds / tpu_seconds, 2),
+                "platform": platform,
+                "columns": n_columns,
+                "hbm_gbps": round(gbps, 1),
             }
-        )
+        ),
+        flush=True,
     )
+
+
+# -------------------------------------------------------------------- parent
+def _run_child(n_shards: int, timeout_s: float, extra_env: dict | None = None):
+    env = dict(os.environ)
+    env["PILOSA_BENCH_CHILD_SHARDS"] = str(n_shards)
+    if extra_env:
+        for k, v in extra_env.items():
+            if v is None:
+                env.pop(k, None)
+            else:
+                env[k] = v
+    try:
+        # stdout carries the one result line; stderr is inherited so the
+        # child's stage lines stream live (and survive a parent timeout)
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env,
+            stdout=subprocess.PIPE,
+            text=True,
+            timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return None, "parent timeout"
+    if proc.returncode == 0:
+        for line in reversed(proc.stdout.splitlines()):
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    return json.loads(line), None
+                except json.JSONDecodeError:
+                    continue
+    tail = (proc.stdout or "").strip().splitlines()
+    return None, f"rc={proc.returncode}: {tail[-1] if tail else 'no stdout'}"
+
+
+def main() -> None:
+    if os.environ.get("PILOSA_BENCH_CHILD_SHARDS"):
+        _child_main(int(os.environ["PILOSA_BENCH_CHILD_SHARDS"]))
+        return
+
+    deadline = time.monotonic() + TOTAL_BUDGET_S
+    scales = [FULL_SHARDS]
+    while scales[-1] > 256:
+        scales.append(max(256, scales[-1] // 8))
+
+    best = None
+    last_err = None
+    # full scale first (the north-star number), stepping down only on
+    # failure; two attempts per scale (fresh process each — a wedged
+    # transport often clears on reconnect)
+    for n_shards in scales:
+        for attempt in range(2):
+            remaining = deadline - time.monotonic()
+            if remaining < 60:
+                break
+            child_timeout = min(remaining, INIT_TIMEOUT_S + 900)
+            _stage({"stage": "attempt", "shards": n_shards, "try": attempt + 1,
+                    "timeout_s": round(child_timeout)})
+            result, err = _run_child(n_shards, child_timeout)
+            if result is not None:
+                best = result
+                break
+            last_err = err
+            _stage({"stage": "attempt_failed", "shards": n_shards, "error": err})
+        if best is not None:
+            break
+
+    if best is None and time.monotonic() < deadline - 120:
+        # final fallback: a CPU-backend run still proves the stack and
+        # yields a nonzero number (flagged via "platform")
+        _stage({"stage": "cpu_fallback"})
+        result, err = _run_child(
+            256, min(deadline - time.monotonic(), 600),
+            {
+                "JAX_PLATFORMS": "cpu",
+                "PILOSA_BENCH_TPU_ITERS": "10",
+                # the box's sitecustomize registers the accelerator PJRT
+                # plugin whenever this is set — a clean CPU process must
+                # not load it at all
+                "PALLAS_AXON_POOL_IPS": None,
+            },
+        )
+        if result is not None:
+            result["error"] = f"accelerator unavailable ({last_err}); cpu fallback"
+            best = result
+
+    if best is None:
+        # same metric name as the success path so aggregators correlate
+        # the failure with the real series
+        from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+        best = {
+            "metric": _metric_name(FULL_SHARDS * SHARD_WIDTH),
+            "value": 0,
+            "unit": "qps",
+            "vs_baseline": 0,
+            "error": f"all attempts failed: {last_err}",
+        }
+    print(json.dumps(best), flush=True)
 
 
 if __name__ == "__main__":
