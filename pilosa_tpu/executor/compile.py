@@ -84,10 +84,11 @@ class StackCache:
 
     MAX_ENTRIES = 64
 
-    def __init__(self):
+    def __init__(self, mesh_ctx=None):
         from collections import OrderedDict
 
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
 
     def matrix(self, idx: Index, field: Field, view_name: str, shards: list[int]):
         """(jnp uint32[S, R, W], n_rows int) for the given shard list."""
@@ -99,7 +100,10 @@ class StackCache:
             self._cache.move_to_end(key)
             return cached[1], cached[2]
         stacked, max_rows = stack_view_matrices(view, shards)
-        dev = jnp.asarray(stacked)
+        if self.mesh_ctx is not None:
+            dev = self.mesh_ctx.place_stack(stacked)
+        else:
+            dev = jnp.asarray(stacked)
         self._cache[key] = (versions, dev, max_rows)
         self._cache.move_to_end(key)
         while len(self._cache) > self.MAX_ENTRIES:
@@ -354,8 +358,9 @@ class QueryCompiler:
     and differ only in their inputs.
     """
 
-    def __init__(self):
-        self.stacks = StackCache()
+    def __init__(self, mesh_ctx=None):
+        self.stacks = StackCache(mesh_ctx)
+        self.mesh_ctx = mesh_ctx
         self._programs: dict[tuple, Callable] = {}
         self._ones: dict[int, Any] = {}
 
@@ -375,6 +380,8 @@ class QueryCompiler:
             cached = jnp.full(
                 (n_shards, WORDS_PER_SHARD), 0xFFFFFFFF, dtype=jnp.uint32
             )
+            if self.mesh_ctx is not None:
+                cached = self.mesh_ctx.place_rows(cached)
             self._ones[n_shards] = cached
         return cached
 

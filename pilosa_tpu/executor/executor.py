@@ -103,9 +103,9 @@ class SumCount(dict):
 
 
 class Executor:
-    def __init__(self, holder: Holder):
+    def __init__(self, holder: Holder, mesh_ctx=None):
         self.holder = holder
-        self.compiler = QueryCompiler()
+        self.compiler = QueryCompiler(mesh_ctx)
 
     # ------------------------------------------------------------ entry
     def execute(

@@ -60,12 +60,26 @@ def field_options_from_json(opts: dict) -> FieldOptions:
 
 
 class API:
-    def __init__(self, holder: Holder, cluster=None, stats=None):
+    def __init__(self, holder: Holder, cluster=None, stats=None, mesh_ctx="auto"):
         self.holder = holder
         self.cluster = cluster  # None ⇒ single-node
-        self.executor = Executor(holder)
+        if mesh_ctx == "auto":
+            # multi-device host ⇒ serve queries as SPMD programs over the
+            # device mesh (the reference's mapReduce scatter-gather becomes
+            # XLA collectives; SURVEY §4.2); single device ⇒ plain arrays
+            from pilosa_tpu.parallel.mesh import MeshContext
+
+            mesh_ctx = MeshContext.auto()
+        self.mesh_ctx = mesh_ctx
+        self.executor = Executor(holder, mesh_ctx=mesh_ctx)
         self.stats = stats
         self.diagnostics = None  # set by Server.open
+
+    def attach_mesh(self, mesh_ctx) -> None:
+        """Late mesh attachment (Server.open does this after the HTTP
+        listener is up so backend init never blocks the bind)."""
+        self.mesh_ctx = mesh_ctx
+        self.executor = Executor(self.holder, mesh_ctx=mesh_ctx)
 
     # ------------------------------------------------------------- schema
     def create_index(self, name: str, options: dict | None = None) -> Index:

@@ -25,7 +25,11 @@ class Server:
         self.holder = Holder(os.path.expanduser(self.config.data_dir))
         self.stats = StatsClient()
         self.cluster = None
-        self.api = API(self.holder, stats=self.stats)
+        # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
+        # backend (seconds, or worse on a wedged transport) — that must
+        # not block Server() construction; open() attaches the mesh AFTER
+        # the listener is serving (see open()'s ordering rationale)
+        self.api = API(self.holder, stats=self.stats, mesh_ctx=None)
         self.http: HTTPServer | None = None
         self.diagnostics = None
         self._anti_entropy_timer: threading.Timer | None = None
@@ -54,6 +58,12 @@ class Server:
             # client could be silently served local-only (and peers 404)
             self.cluster.attach()
         self.http.serve_background()
+        if self.config.mesh_enabled:
+            from pilosa_tpu.parallel.mesh import MeshContext
+
+            self.api.attach_mesh(
+                MeshContext.auto(words_axis=self.config.mesh_words_axis)
+            )
         if self.cluster is not None:
             self.cluster.join()
         self._schedule_anti_entropy()

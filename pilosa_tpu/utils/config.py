@@ -28,6 +28,9 @@ class Config:
     # limits
     max_writes_per_request: int = 5000
     long_query_time: float = 0.0  # seconds; log slower queries (0 = off)
+    # device mesh (serving-path SPMD over all local devices)
+    mesh_enabled: bool = True
+    mesh_words_axis: int = 1  # >1 splits the packed word dim across devices
     # metrics
     metric_service: str = "prometheus"
 
@@ -108,6 +111,8 @@ def config_template() -> str:
         "diagnostics-interval = 3600.0\n"
         "max-writes-per-request = 5000\n"
         "long-query-time = 0.0\n"
+        "mesh-enabled = true\n"
+        "mesh-words-axis = 1\n"
         'metric-service = "prometheus"\n'
     )
 
