@@ -77,6 +77,12 @@ class Fragment:
         # aliases a cache entry
         self.version = 0
         self.uid = next(_FRAGMENT_UIDS)
+        # (version, row) log so stacked-matrix caches can apply O(dirty
+        # rows) device-side deltas instead of re-uploading the stack;
+        # bounded — readers asking about versions older than _dirty_floor
+        # get None (= unknown, do a full restack)
+        self._dirty_history: list[tuple[int, int]] = []
+        self._dirty_floor = 0
 
     # ----------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -94,8 +100,7 @@ class Fragment:
                     self._write_snapshot()
                 self._file = open(self.path, "ab")
             self._rebuild_cache()
-            self._all_dirty = True
-            self._device = None
+            self._mark_all_dirty()
 
     def close(self) -> None:
         with self._lock:
@@ -268,15 +273,36 @@ class Fragment:
             roaring.replay_ops(incoming, data[consumed:])
             self.bitmap = self.bitmap | incoming
             self.snapshot()
-            self._all_dirty = True
-            self._device = None
-            self.version += 1
+            self._mark_all_dirty()
             self._rebuild_cache()
+
+    DIRTY_HISTORY_MAX = 4096
 
     def _mark_dirty(self, row: int) -> None:
         self._dirty_rows.add(row)
         self._device = None
         self.version += 1
+        self._dirty_history.append((self.version, row))
+        if len(self._dirty_history) > self.DIRTY_HISTORY_MAX:
+            drop = len(self._dirty_history) // 2
+            self._dirty_floor = self._dirty_history[drop - 1][0]
+            del self._dirty_history[:drop]
+
+    def _mark_all_dirty(self) -> None:
+        """Bulk/out-of-band rewrite: delta tracking restarts here."""
+        self._all_dirty = True
+        self._device = None
+        self.version += 1
+        self._dirty_history.clear()
+        self._dirty_floor = self.version
+
+    def dirty_rows_since(self, version: int) -> set[int] | None:
+        """Rows dirtied after ``version``, or None when unknowable (the
+        history was trimmed, or a bulk rewrite happened)."""
+        with self._lock:
+            if version < self._dirty_floor:
+                return None
+            return {r for v, r in self._dirty_history if v > version}
 
     def _rebuild_cache(self) -> None:
         self.cache.clear()
@@ -369,7 +395,5 @@ class Fragment:
             self.bitmap.remove_many(existing)
             self.bitmap.add_many(incoming)
             self.snapshot()
-            self._all_dirty = True
-            self._device = None
-            self.version += 1
+            self._mark_all_dirty()
             self._rebuild_cache()

