@@ -308,13 +308,33 @@ class Field:
         rows = np.asarray(rows, dtype=np.uint64)
         cols = np.asarray(cols, dtype=np.uint64)
         if self.options.field_type in (FIELD_MUTEX, FIELD_BOOL):
-            # mutex semantics are per-bit; route through set_bit
-            for i in range(rows.size):
-                if clear:
-                    self.clear_bit(int(rows[i]), int(cols[i]))
-                else:
-                    ts = timestamps[i] if timestamps else None
-                    self.set_bit(int(rows[i]), int(cols[i]), ts)
+            if rows.size == 0:
+                return
+            if self.options.field_type == FIELD_BOOL and not np.isin(
+                rows, (0, 1)
+            ).all():
+                raise ValueError("bool field rows must be 0 or 1")
+            if clear:
+                # clearing needs no single-value enforcement — plain batch
+                shards = cols // np.uint64(SHARD_WIDTH)
+                for shard in np.unique(shards).tolist():
+                    m = shards == shard
+                    frag = self.create_view_if_not_exists(
+                        VIEW_STANDARD
+                    ).create_fragment_if_not_exists(int(shard))
+                    frag.bulk_import(rows[m], cols[m], clear=True)
+                return
+            # last-wins per column, then one vectorized mutex pass per shard
+            _, last = np.unique(cols[::-1], return_index=True)
+            keep = np.sort(cols.size - 1 - last)
+            rows, cols = rows[keep], cols[keep]
+            shards = cols // np.uint64(SHARD_WIDTH)
+            for shard in np.unique(shards).tolist():
+                m = shards == shard
+                frag = self.create_view_if_not_exists(
+                    VIEW_STANDARD
+                ).create_fragment_if_not_exists(int(shard))
+                frag.mutex_import(rows[m], cols[m])
             return
         shards = cols // np.uint64(SHARD_WIDTH)
         for shard in np.unique(shards).tolist():

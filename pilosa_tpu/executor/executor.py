@@ -43,7 +43,7 @@ from pilosa_tpu.executor.compile import PlanError, QueryCompiler
 from pilosa_tpu.executor.row import RowResult
 from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
-from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 
 def apply_options(idx: "Index", call: "Call", res: Any) -> Any:
     """Apply an Options() wrapper's result-shaping args (reference:
@@ -95,6 +95,26 @@ class ExecutionError(ValueError):
     pass
 
 
+@jax.jit
+def _gb_counts(masks, matrix, rows):
+    """GroupBy level counts: [G,S,W] masks × K candidate rows → int64[G,K]
+    in one dispatch (lax.map bounds transient memory to one row batch)."""
+    gathered = jnp.take(matrix, rows, axis=1, mode="fill", fill_value=0)
+    per_row = lambda rm: jnp.sum(
+        ops.popcount_words(masks & rm[None]).astype(jnp.int64), axis=(1, 2)
+    )
+    return jax.lax.map(per_row, jnp.moveaxis(gathered, 1, 0)).T
+
+
+@jax.jit
+def _gb_masks(masks, matrix, g_idx, row_sel):
+    """Materialize surviving groups' masks: gather parent masks and
+    candidate rows, AND them — one dispatch per level."""
+    sel = jnp.take(masks, g_idx, axis=0)
+    rows = jnp.take(matrix, row_sel, axis=1, mode="fill", fill_value=0)
+    return sel & jnp.moveaxis(rows, 1, 0)
+
+
 class SumCount(dict):
     """Sum/Min/Max result: {"value": v, "count": n} (reference: ValCount)."""
 
@@ -103,6 +123,11 @@ class SumCount(dict):
 
 
 class Executor:
+    # device-memory cap for GroupBy's [G, S, W] group-mask tensor; levels
+    # surviving more groups than fit are processed in chunks (see
+    # _execute_group_by)
+    GROUPBY_MASK_BUDGET = 256 * 1024 * 1024
+
     def __init__(self, holder: Holder, mesh_ctx=None):
         self.holder = holder
         self.compiler = QueryCompiler(mesh_ctx)
@@ -262,17 +287,27 @@ class Executor:
         return m[:, :need]
 
     # ------------------------------------------------------- aggregates
+    @staticmethod
+    def _sum_fn(s, f):
+        """(slices [S,D,W], filt [S,W]) → (pos[D], neg[D], n) — the ONE
+        BSI-sum reduction body; Sum jits it directly and GroupBy's
+        aggregate wraps it in a group vmap so the two stay in sync."""
+        return tuple(
+            x.astype(jnp.int64).sum(axis=0)
+            for x in jax.vmap(ops.bsi.sum_counts)(s, f)
+        )
+
     def _sum_program(self, field: Field, n_shards: int):
-        """Compiled vmapped BSI sum over stacked slices; shared by Sum and
-        GroupBy's aggregate."""
         return self.compiler.program(
             ("sum", n_shards, field.bit_depth),
-            lambda: jax.jit(
-                lambda s, f: tuple(
-                    x.astype(jnp.int64).sum(axis=0)
-                    for x in jax.vmap(ops.bsi.sum_counts)(s, f)
-                )
-            ),
+            lambda: jax.jit(self._sum_fn),
+        )
+
+    def _grouped_sum_program(self, field: Field, n_shards: int):
+        """(slices [S,D,W], masks [G,S,W]) → (pos[G,D], neg[G,D], n[G])."""
+        return self.compiler.program(
+            ("gb_sums", n_shards, field.bit_depth),
+            lambda: jax.jit(jax.vmap(self._sum_fn, in_axes=(None, 0))),
         )
 
     def _execute_sum(self, idx: Index, call: Call, shards: list[int]) -> SumCount:
@@ -439,24 +474,6 @@ class Executor:
                 self.compiler.stacks.matrix(idx, f, VIEW_STANDARD, shards)[0]
             )
 
-        # one-dispatch-per-node helpers
-        step = self.compiler.program(
-            ("gb_step", len(shards)),
-            lambda: jax.jit(
-                lambda mask, matrix, row: (
-                    lambda nm: (nm, jnp.sum(ops.popcount_rows(nm).astype(jnp.int64)))
-                )(
-                    mask
-                    & jnp.take(
-                        matrix, row, axis=1, mode="fill", fill_value=0
-                    )
-                )
-            ),
-        )
-        sum_prog = (
-            self._sum_program(agg_field, len(shards)) if agg_field is not None else None
-        )
-
         if filter_call is not None:
             if not isinstance(filter_call, Call):
                 raise ExecutionError("GroupBy filter must be a call")
@@ -466,34 +483,96 @@ class Executor:
         else:
             base_mask = self.compiler.ones(len(shards))
 
-        results: list[dict] = []
+        # Level-synchronous evaluation: a whole nesting level runs in TWO
+        # device dispatches — (1) counts of every (surviving group ×
+        # candidate row) pair, (2) materialization of the surviving
+        # groups' masks — instead of the reference's one-executor-pass-
+        # per-group (executor.go executeGroupBy; round-1 code dispatched
+        # one program per candidate row). Device memory for the [G, S, W]
+        # group-mask tensor is bounded by GROUPBY_MASK_BUDGET: when a
+        # level survives more groups than fit, the pair list is processed
+        # in mask-budget-sized chunks depth-first (order — and therefore
+        # limit semantics — is preserved because chunks run in pair
+        # order). Shapes pad to powers of two so recompiles stay rare.
+        n_shards = len(shards)
+        chunk_cap = max(
+            1, self.GROUPBY_MASK_BUDGET // (n_shards * WORDS_PER_SHARD * 4)
+        )
 
-        def recurse(level: int, group: list[tuple[Field, int]], mask, count):
+        def _pow2(n: int) -> int:
+            return 1 << max(0, (n - 1)).bit_length()
+
+        results: list[dict] = []
+        sum_prog = (
+            self._grouped_sum_program(agg_field, n_shards)
+            if agg_slices is not None
+            else None
+        )
+
+        def emit(groups: list[tuple], counts: np.ndarray, masks) -> None:
+            start = len(results)
+            for grp, c in zip(groups, counts.tolist()):
+                results.append(
+                    {
+                        "group": [
+                            {"field": f.name, "rowID": rid} for f, rid in grp
+                        ],
+                        "count": int(c),
+                    }
+                )
+            if sum_prog is not None:
+                pos, neg, _n = (
+                    np.asarray(x) for x in sum_prog(agg_slices, masks)
+                )
+                for i in range(len(groups)):
+                    results[start + i]["sum"] = ops.bsi.weigh_sum(pos[i], neg[i])
+
+        def expand(level: int, masks, groups: list[tuple]) -> None:
             if limit is not None and len(results) >= limit:
                 return
-            if level == len(fields):
-                # count was computed by the step that produced this mask
-                if count == 0:
+            rows_l = row_lists[level]
+            k_pad = _pow2(len(rows_l))
+            rows_arr = np.full(k_pad, -1, dtype=np.int32)
+            rows_arr[: len(rows_l)] = rows_l
+            cnp = np.asarray(
+                _gb_counts(masks, matrices[level], jnp.asarray(rows_arr))
+            )[: len(groups), : len(rows_l)]
+            pairs = np.argwhere(cnp > 0)  # (g-major, k-minor) = lexicographic
+            last = level == len(fields) - 1
+            if last and limit is not None:
+                pairs = pairs[: limit - len(results)]
+            for lo in range(0, pairs.shape[0], chunk_cap):
+                chunk = pairs[lo : lo + chunk_cap]
+                p_pad = _pow2(chunk.shape[0])
+                g_idx = np.zeros(p_pad, dtype=np.int32)
+                row_sel = np.full(p_pad, -1, dtype=np.int32)
+                g_idx[: chunk.shape[0]] = chunk[:, 0]
+                row_sel[: chunk.shape[0]] = [rows_l[k] for k in chunk[:, 1]]
+                sub_groups = [
+                    groups[g] + ((fields[level], rows_l[k]),)
+                    for g, k in chunk.tolist()
+                ]
+                if last and sum_prog is None:
+                    # counts suffice — skip materializing final masks
+                    emit(sub_groups, cnp[chunk[:, 0], chunk[:, 1]], None)
+                else:
+                    sub_masks = _gb_masks(
+                        masks,
+                        matrices[level],
+                        jnp.asarray(g_idx),
+                        jnp.asarray(row_sel),
+                    )[: chunk.shape[0]]
+                    if last:
+                        emit(
+                            sub_groups, cnp[chunk[:, 0], chunk[:, 1]], sub_masks
+                        )
+                    else:
+                        expand(level + 1, sub_masks, sub_groups)
+                if limit is not None and len(results) >= limit:
                     return
-                entry = {
-                    "group": [{"field": f.name, "rowID": rid} for f, rid in group],
-                    "count": count,
-                }
-                if agg_slices is not None:
-                    pos, neg, _n = sum_prog(agg_slices, mask)
-                    entry["sum"] = ops.bsi.weigh_sum(
-                        np.asarray(pos), np.asarray(neg)
-                    )
-                results.append(entry)
-                return
-            for rid in row_lists[level]:
-                new_mask, cnt = step(mask, matrices[level], jnp.int32(rid))
-                cnt = int(cnt)
-                if cnt == 0:
-                    continue  # prune: deeper intersections stay empty
-                recurse(level + 1, group + [(fields[level], rid)], new_mask, cnt)
 
-        recurse(0, [], base_mask, -1)
+        if all(row_lists):
+            expand(0, base_mask[None], [()])
         return results
 
     # ------------------------------------------------------------ writes

@@ -106,6 +106,27 @@ class Bitmap:
         c = self._containers.get(int(v) >> 16)
         return c is not None and ct.container_contains(c, int(v) & 0xFFFF)
 
+    def contains_many(self, values: np.ndarray) -> np.ndarray:
+        """Vectorised membership: bool[len(values)], grouped by container
+        key with one np.isin per touched container."""
+        values = np.asarray(values, dtype=np.uint64)
+        out = np.zeros(values.size, dtype=bool)
+        if values.size == 0 or not self._containers:
+            return out
+        keys = (values >> _KEY_SHIFT).astype(np.int64)
+        lows = (values & _LOW_MASK).astype(np.uint16)
+        order = np.argsort(keys, kind="stable")
+        ks = keys[order]
+        uniq, starts = np.unique(ks, return_index=True)
+        bounds = np.append(starts, ks.size)
+        for i, key in enumerate(uniq.tolist()):
+            c = self._containers.get(int(key))
+            if c is None:
+                continue
+            sel = order[bounds[i] : bounds[i + 1]]
+            out[sel] = np.isin(lows[sel], ct.as_values(c))
+        return out
+
     def count(self) -> int:
         return sum(ct.container_count(c) for c in self._containers.values())
 

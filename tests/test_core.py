@@ -45,6 +45,9 @@ def test_fragment_persistence_and_oplog_replay(tmp_path):
     assert frag2.contains(0, 1) and frag2.contains(0, 9)
     assert frag2.contains(1, 9) and frag2.contains(1, 50)
     assert frag2.contains(5, 1000)
+    # rank cache is opt-in now (TopN is exact on device; no per-mutation
+    # maintenance) — an explicit rebuild still works
+    frag2.rebuild_cache()
     assert frag2.cache.get(0) == 2
     frag2.close()
 
