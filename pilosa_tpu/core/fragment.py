@@ -92,6 +92,11 @@ class Fragment:
         # get None (= unknown, do a full restack)
         self._dirty_history: list[tuple[int, int]] = []
         self._dirty_floor = 0
+        # lazily-computed upper bound on the max set position: n_rows()
+        # must be O(1) (the stack-budget check runs per query); adds
+        # raise it incrementally, removes leave it stale-high (harmless —
+        # overestimates only pad), bulk rewrites reset it
+        self._approx_max_pos = -1
 
     # ----------------------------------------------------------- lifecycle
     def open(self) -> None:
@@ -150,7 +155,13 @@ class Fragment:
     def n_rows(self) -> int:
         if not self.bitmap._containers:
             return 0
-        return self.bitmap.max() // SHARD_WIDTH + 1
+        if self._approx_max_pos < 0:
+            self._approx_max_pos = int(self.bitmap.max())
+        return self._approx_max_pos // SHARD_WIDTH + 1
+
+    def _raise_max_pos(self, pos: int) -> None:
+        if self._approx_max_pos >= 0:
+            self._approx_max_pos = max(self._approx_max_pos, int(pos))
 
     def row_ids(self) -> list[int]:
         """Row IDs with ≥1 bit set. Derived from container keys (each key
@@ -195,6 +206,7 @@ class Fragment:
             changed = self.bitmap.add(pos)
             if changed:
                 self._append_op(roaring.OP_ADD, np.array([pos], dtype=np.uint64))
+                self._raise_max_pos(pos)
                 self._mark_dirty(row)
             return changed
 
@@ -233,6 +245,7 @@ class Fragment:
                 ) + np.uint64(row * SHARD_WIDTH)
                 self.bitmap.add_many(positions)
                 self._append_op(roaring.OP_ADD, positions)
+                self._raise_max_pos(int(positions.max()))
             self._mark_dirty(row)
             return True
 
@@ -264,6 +277,7 @@ class Fragment:
             else:
                 self.bitmap.add_many(positions)
                 self._append_op(roaring.OP_ADD, positions)
+                self._raise_max_pos(int(positions.max()))
             for r in np.unique(rows).tolist():
                 self._mark_dirty(int(r))
 
@@ -318,6 +332,7 @@ class Fragment:
             positions = rows * np.uint64(SHARD_WIDTH) + rel
             self.bitmap.add_many(positions)
             self._append_op(roaring.OP_ADD, positions)
+            self._raise_max_pos(int(positions.max()))
             for r in np.unique(rows).tolist():
                 self._mark_dirty(int(r))
 
@@ -346,6 +361,7 @@ class Fragment:
 
     def _mark_all_dirty(self) -> None:
         """Bulk/out-of-band rewrite: delta tracking restarts here."""
+        self._approx_max_pos = -1
         self._all_dirty = True
         self._device = None
         self.version += 1

@@ -21,6 +21,7 @@ abstracted out; jax.jit's own shape cache handles S/R/W changes.
 
 from __future__ import annotations
 
+import os
 import threading
 from datetime import datetime
 from typing import Any, Callable
@@ -48,6 +49,25 @@ from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
 class PlanError(ValueError):
     pass
+
+
+class StackOverBudget(Exception):
+    """A field's dense [S, R, W] stack would exceed the device budget.
+
+    Raised EXPLICITLY instead of letting the allocation OOM (SURVEY §7
+    hard part (e)). Callers fall back: Row() leaves go through the
+    hot-row slot stack, TopN streams row chunks; anything else surfaces
+    the clear error."""
+
+    def __init__(self, field: str, rows: int, bytes_needed: int, budget: int):
+        self.field, self.rows = field, rows
+        self.bytes_needed, self.budget = bytes_needed, budget
+        super().__init__(
+            f"field {field!r}: dense stack of {rows} rows needs "
+            f"{bytes_needed / 2**20:.0f} MiB on device (budget "
+            f"{budget / 2**20:.0f} MiB); high-cardinality fields answer "
+            "Row/Count/TopN via the hot-row path"
+        )
 
 
 # --------------------------------------------------------------- stacking
@@ -101,21 +121,49 @@ class StackCache:
 
     MAX_ENTRIES = 64
     MAX_DELTA_ROWS = 1024  # beyond this a full restack is cheaper
+    # device-bytes cap for any one dense stack; larger fields take the
+    # hot-row path (env override for tests/operators)
+    STACK_BYTES_BUDGET = int(os.environ.get("PILOSA_TPU_STACK_BUDGET", 2 << 30))
 
     def __init__(self, mesh_ctx=None):
         from collections import OrderedDict
 
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._hot: "OrderedDict[tuple, dict]" = OrderedDict()
         self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
         self._lock = threading.Lock()
         # observability: tests assert the write path stays incremental
         self.full_restacks = 0
         self.delta_updates = 0
         self.delta_rows_uploaded = 0
+        self.hot_row_uploads = 0
+
+    @staticmethod
+    def _projected_rows(view, shards: list[int]) -> int:
+        """Padded stack height WITHOUT materializing any host matrix —
+        the over-budget check must not itself allocate O(R·W)."""
+        from pilosa_tpu.core.fragment import _pad_rows
+
+        n = 1
+        for s in shards:
+            frag = view.fragment(s) if view else None
+            if frag is not None:
+                n = max(n, frag.n_rows())
+        return _pad_rows(n)
 
     def matrix(self, idx: Index, field: Field, view_name: str, shards: list[int]):
-        """(jnp uint32[S, R, W], n_rows int) for the given shard list."""
+        """(jnp uint32[S, R, W], n_rows int) for the given shard list.
+
+        Raises StackOverBudget when the dense stack would exceed
+        STACK_BYTES_BUDGET — callers use hot_slot()/hot_dev() or chunked
+        scans instead."""
         view = field.view(view_name)
+        r_pad = self._projected_rows(view, shards)
+        need = len(shards) * r_pad * WORDS_PER_SHARD * 4
+        if need > self.STACK_BYTES_BUDGET:
+            raise StackOverBudget(
+                field.name, r_pad, need, self.STACK_BYTES_BUDGET
+            )
         key = (idx.name, field.name, view_name, tuple(shards))
         with self._lock:
             versions = tuple(self._frag_token(view, s) for s in shards)
@@ -207,6 +255,138 @@ class StackCache:
     def invalidate(self) -> None:
         with self._lock:
             self._cache.clear()
+            self._hot.clear()
+
+    # ----------------------------------------------------- hot-row stacks
+    # High-cardinality fields (dense stack over STACK_BYTES_BUDGET) keep
+    # only an LRU working set of rows on device: a [S, H, W] slot stack
+    # plus a row→slot map. Cold rows live in the host roaring bitmaps and
+    # are promoted on first touch with an O(S·W) scatter — never a full
+    # host matrix (SURVEY §7 hard part (e)).
+
+    def hot_capacity(self, n_shards: int) -> int:
+        h = self.STACK_BYTES_BUDGET // max(1, n_shards * WORDS_PER_SHARD * 4)
+        return max(8, 1 << (int(h).bit_length() - 1)) if h >= 8 else 8
+
+    MAX_HOT_ENTRIES = 4  # each slot stack is up to a full budget of HBM
+
+    def _hot_entry(self, idx: Index, field: Field, view_name: str, shards):
+        view = field.view(view_name)
+        key = ("hot", idx.name, field.name, view_name, tuple(shards))
+        versions = tuple(self._frag_token(view, s) for s in shards)
+        entry = self._hot.get(key)
+        h = self.hot_capacity(len(shards))
+        if entry is None or entry["h"] != h:
+            from collections import OrderedDict
+
+            zeros = np.zeros((len(shards), h, WORDS_PER_SHARD), dtype=np.uint32)
+            dev = (
+                self.mesh_ctx.place_stack(zeros)
+                if self.mesh_ctx is not None
+                else jnp.asarray(zeros)
+            )
+            entry = {
+                "versions": versions,
+                "dev": dev,
+                "slots": OrderedDict(),
+                "h": h,
+            }
+            self._hot[key] = entry
+            self._hot.move_to_end(key)
+            while len(self._hot) > self.MAX_HOT_ENTRIES:
+                self._hot.popitem(last=False)
+            return entry, view
+        self._hot.move_to_end(key)
+        if entry["versions"] != versions:
+            # reconcile resident rows against fragment mutations
+            stale: set[int] | None = set()
+            for i, s in enumerate(shards):
+                old_uid, old_ver = entry["versions"][i]
+                new_uid, new_ver = versions[i]
+                if (old_uid, old_ver) == (new_uid, new_ver):
+                    continue
+                frag = view.fragment(s) if view else None
+                if frag is None or old_uid != new_uid:
+                    stale = None
+                    break
+                dirty = frag.dirty_rows_since(old_ver)
+                if dirty is None:
+                    stale = None
+                    break
+                stale |= dirty
+            if stale is None:
+                entry["slots"].clear()
+            else:
+                for r in stale & set(entry["slots"]):
+                    self._upload_hot_row(entry, view, shards, r, entry["slots"][r])
+            entry["versions"] = versions
+        return entry, view
+
+    def _upload_hot_rows(self, entry, view, shards, pairs: list[tuple[int, int]]):
+        """One batched scatter for every (row_id, slot) pair — the slot
+        stack is full-copied per scatter, so k rows must cost one copy,
+        not k."""
+        if not pairs:
+            return
+        n_s = len(shards)
+        k = len(pairs)
+        data = np.zeros((k * n_s, WORDS_PER_SHARD), dtype=np.uint32)
+        idx_arr = np.empty((k * n_s, 2), dtype=np.int32)
+        for j, (row_id, slot) in enumerate(pairs):
+            for i, s in enumerate(shards):
+                frag = view.fragment(s) if view else None
+                if frag is not None:
+                    data[j * n_s + i] = frag.row_packed(row_id)
+                idx_arr[j * n_s + i] = (i, slot)
+        new_dev = _apply_stack_delta(entry["dev"], idx_arr, data)
+        if new_dev.sharding != entry["dev"].sharding:
+            new_dev = jax.device_put(new_dev, entry["dev"].sharding)
+        entry["dev"] = new_dev
+        self.hot_row_uploads += len(pairs)
+
+    def hot_batch(
+        self,
+        idx: Index,
+        field: Field,
+        view_name: str,
+        shards: list[int],
+        row_ids: list[int],
+    ):
+        """Atomically ensure EVERY row in ``row_ids`` is device-resident
+        and return ``(dev [S,H,W], {row_id: slot})`` captured in one
+        critical section. The returned array object is immutable — later
+        evictions by other queries scatter into a NEW array, so a
+        program compiled against this (dev, slots) pair can never read a
+        reassigned slot (code-review r2: plan-time slots must not go
+        stale before dispatch)."""
+        with self._lock:
+            entry, view = self._hot_entry(idx, field, view_name, shards)
+            slots = entry["slots"]
+            need = [r for r in dict.fromkeys(row_ids) if r >= 0]
+            if len(need) > entry["h"]:
+                raise StackOverBudget(
+                    field.name,
+                    len(need),
+                    len(need) * len(shards) * WORDS_PER_SHARD * 4,
+                    self.STACK_BYTES_BUDGET,
+                )
+            # bump every needed resident row first so the LRU never
+            # evicts one member of this batch to admit another
+            for r in need:
+                if r in slots:
+                    slots.move_to_end(r)
+            uploads: list[tuple[int, int]] = []
+            for r in need:
+                if r in slots:
+                    continue
+                if len(slots) < entry["h"]:
+                    slot = len(slots)
+                else:
+                    _evicted, slot = slots.popitem(last=False)
+                slots[r] = slot
+                uploads.append((r, slot))
+            self._upload_hot_rows(entry, view, shards, uploads)
+            return entry["dev"], {r: slots[r] for r in need}
 
 
 # ------------------------------------------------------------------ plans
@@ -217,29 +397,74 @@ class _Planner:
         self.idx = idx
         self.shards = shards
         self.stacks = stacks
-        self.arrays: list[Any] = []  # device inputs (stacked matrices)
-        self.scalars: list[int] = []  # traced row-id inputs
+        self._builders: list[Callable[[], Any]] = []  # device-input thunks
+        self.scalars: list = []  # traced row-id/slot inputs (int | thunk)
         self._array_keys: dict[tuple, int] = {}
+        # over-budget fields: rows each query leaf needs, resolved to an
+        # atomic (dev, slots) snapshot at materialize time
+        self._hot_needs: dict[tuple, tuple[Field, str, list[int]]] = {}
+        self._hot_resolved: dict[tuple, tuple] = {}
 
     def _add_array(self, key: tuple, build: Callable[[], Any]) -> int:
         i = self._array_keys.get(key)
         if i is None:
-            i = len(self.arrays)
+            i = len(self._builders)
             self._array_keys[key] = i
-            self.arrays.append(build())
+            self._builders.append(build)
         return i
+
+    def materialize(self) -> list[Any]:
+        """Resolve device inputs AFTER planning finishes. Hot-row fields
+        resolve here as ONE atomic hot_batch per field — plan-time slot
+        binding could go stale if a concurrent query evicted a row
+        between planning and dispatch; the batch snapshot cannot."""
+        for fkey, (field, view_name, rows) in self._hot_needs.items():
+            self._hot_resolved[fkey] = self.stacks.hot_batch(
+                self.idx, field, view_name, self.shards, rows
+            )
+        return [b() for b in self._builders]
+
+    def scalar_values(self) -> list[int]:
+        """Concrete traced-scalar inputs; call AFTER materialize() (hot
+        slots resolve there)."""
+        return [s() if callable(s) else s for s in self.scalars]
 
     def _add_scalar(self, value: int) -> int:
         self.scalars.append(int(value))
         return len(self.scalars) - 1
 
     def _matrix_leaf(self, field: Field, view_name: str, row_id: int):
-        """closure(arrays, scalars) → uint32[S, W] for one stored row."""
-        ai = self._add_array(
-            ("m", field.name, view_name),
-            lambda: self.stacks.matrix(self.idx, field, view_name, self.shards)[0],
-        )
-        si = self._add_scalar(row_id)
+        """closure(arrays, scalars) → uint32[S, W] for one stored row.
+
+        Small fields read a slot of the full dense stack; over-budget
+        fields promote the row into the hot slot stack and read that
+        slot instead (same closure shape — only the traced index
+        differs)."""
+        try:
+            # probing the budget up front keeps one compiled program per
+            # (field mode); the check allocates nothing
+            self.stacks.matrix(self.idx, field, view_name, self.shards)
+            ai = self._add_array(
+                ("m", field.name, view_name),
+                lambda: self.stacks.matrix(
+                    self.idx, field, view_name, self.shards
+                )[0],
+            )
+            si = self._add_scalar(row_id)
+            mode = "m"
+        except StackOverBudget:
+            fkey = (field.name, view_name)
+            need = self._hot_needs.setdefault(fkey, (field, view_name, []))
+            if row_id >= 0:
+                need[2].append(row_id)
+            ai = self._add_array(
+                ("hot",) + fkey, lambda: self._hot_resolved[fkey][0]
+            )
+            self.scalars.append(
+                lambda: self._hot_resolved[fkey][1].get(row_id, -1)
+            )
+            si = len(self.scalars) - 1
+            mode = "hot"
 
         def run(arrays, scalars):
             m = arrays[ai]
@@ -247,7 +472,7 @@ class _Planner:
             # out-of-range / -1 rows read as zeros
             return jnp.take(m, row, axis=1, mode="fill", fill_value=0)
 
-        return run, f"row(m:{field.name}/{view_name})"
+        return run, f"row({mode}:{field.name}/{view_name})"
 
     def _existence(self):
         ef = self.idx.field(EXISTENCE_FIELD)
@@ -488,7 +713,8 @@ class QueryCompiler:
         prog = self.program(
             key, lambda: jax.jit(lambda arrays, scalars: run(arrays, scalars))
         )
-        return prog(planner.arrays, jnp.asarray(planner.scalars, jnp.int32))
+        arrays = planner.materialize()
+        return prog(arrays, jnp.asarray(planner.scalar_values(), jnp.int32))
 
     def bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
         return np.asarray(self.bitmap_device(idx, call, shards))
@@ -508,7 +734,8 @@ class QueryCompiler:
             return prog
 
         prog = self.program(key, build)
-        return prog(planner.arrays, jnp.asarray(planner.scalars, jnp.int32))
+        arrays = planner.materialize()
+        return prog(arrays, jnp.asarray(planner.scalar_values(), jnp.int32))
 
     def count(self, idx: Index, call: Call, shards: list[int]) -> int:
         return int(self.count_async(idx, call, shards))

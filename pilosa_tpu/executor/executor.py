@@ -39,7 +39,7 @@ from pilosa_tpu.core import (
     Holder,
     Index,
 )
-from pilosa_tpu.executor.compile import PlanError, QueryCompiler
+from pilosa_tpu.executor.compile import PlanError, QueryCompiler, StackOverBudget
 from pilosa_tpu.executor.row import RowResult
 from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
@@ -187,7 +187,7 @@ class Executor:
                 return self._execute_group_by(idx, call, shard_list)
             if name == "IncludesColumn":
                 return self._execute_includes_column(idx, call, shard_list)
-        except PlanError as e:
+        except (PlanError, StackOverBudget) as e:
             raise ExecutionError(str(e)) from e
         raise ExecutionError(f"unknown call {name!r}")
 
@@ -279,8 +279,13 @@ class Executor:
         return self.compiler.ones(len(shards))
 
     def _bsi_stacked(self, idx: Index, field: Field, shards: list[int]):
-        """uint32[S, D, W] bit-slice block for an int field (device)."""
-        m, _rows = self.compiler.stacks.matrix(idx, field, VIEW_BSI, shards)
+        """uint32[S, D, W] bit-slice block for an int field (device).
+        BSI depth is ≤ 66 rows, so the budget can only trip on huge shard
+        lists — surface it clearly if it does."""
+        try:
+            m, _rows = self.compiler.stacks.matrix(idx, field, VIEW_BSI, shards)
+        except StackOverBudget as e:
+            raise ExecutionError(str(e)) from e
         need = BSI_OFFSET + field.bit_depth
         if m.shape[1] < need:
             m = jnp.pad(m, ((0, 0), (0, need - m.shape[1]), (0, 0)))
@@ -352,10 +357,16 @@ class Executor:
         if attr_name is not None and not attr_values:
             raise ExecutionError("TopN() attrName requires attrValues")
 
-        matrix, n_rows = self.compiler.stacks.matrix(
-            idx, field, VIEW_STANDARD, shards
-        )
         filt = self._filter_device(idx, call, shards)
+        try:
+            matrix, n_rows = self.compiler.stacks.matrix(
+                idx, field, VIEW_STANDARD, shards
+            )
+        except StackOverBudget:
+            pairs = self._topn_chunked(
+                idx, field, shards, filt, ids=ids
+            )
+            return self._topn_finish(field, pairs, n, attr_name, attr_values)
         if ids is not None:
             row_ids = jnp.asarray(ids, jnp.int32)
             prog = self.compiler.program(
@@ -385,6 +396,12 @@ class Executor:
             nz = np.flatnonzero(counts)
             pairs = [(int(r), int(counts[r])) for r in nz.tolist()]
 
+        return self._topn_finish(field, pairs, n, attr_name, attr_values)
+
+    @staticmethod
+    def _topn_finish(
+        field: Field, pairs: list, n, attr_name, attr_values
+    ) -> list[dict]:
         if attr_name is not None:
             allowed = set(attr_values)
             pairs = [
@@ -402,6 +419,46 @@ class Executor:
                 entry["key"] = field.row_keys.translate_id(rid) or str(rid)
             out.append(entry)
         return out
+
+    def _topn_chunked(
+        self, idx: Index, field: Field, shards: list[int], filt, ids=None
+    ) -> list:
+        """TopN for over-budget (high-cardinality) fields: stream row
+        chunks host-roaring → device, count, discard — device memory stays
+        within the hot budget while every row is still counted EXACTLY
+        (SURVEY §7 hard part (e); reference: fragment.go top full scan)."""
+        view = field.view(VIEW_STANDARD)
+        rows = list(ids) if ids is not None else self._rows_of_field(field, shards)
+        if not rows:
+            return []
+        stacks = self.compiler.stacks
+        chunk = stacks.hot_capacity(len(shards))
+        frags = [view.fragment(s) if view else None for s in shards]
+        prog = self.compiler.program(
+            ("topn_chunk", len(shards)),
+            lambda: jax.jit(
+                lambda g, f: jnp.sum(
+                    ops.popcount_words(g & f[:, None, :]).astype(jnp.int64),
+                    axis=(0, 2),
+                )
+            ),
+        )
+        pairs: list = []
+        for lo in range(0, len(rows), chunk):
+            sub = rows[lo : lo + chunk]
+            host = np.zeros(
+                (len(shards), len(sub), WORDS_PER_SHARD), dtype=np.uint32
+            )
+            for i, frag in enumerate(frags):
+                if frag is None:
+                    continue
+                for j, r in enumerate(sub):
+                    host[i, j] = frag.row_packed(r)
+            counts = np.asarray(prog(jnp.asarray(host), filt))
+            for j, r in enumerate(sub):
+                if counts[j] > 0:
+                    pairs.append((int(r), int(counts[j])))
+        return pairs
 
     def _rows_of_field(self, field: Field, shards: list[int]) -> list[int]:
         rows: set[int] = set()
@@ -470,9 +527,12 @@ class Executor:
             if rlimit is not None:
                 rows = rows[:rlimit]
             row_lists.append(rows)
-            matrices.append(
-                self.compiler.stacks.matrix(idx, f, VIEW_STANDARD, shards)[0]
-            )
+            try:
+                matrices.append(
+                    self.compiler.stacks.matrix(idx, f, VIEW_STANDARD, shards)[0]
+                )
+            except StackOverBudget as e:
+                raise ExecutionError(f"GroupBy: {e}") from e
 
         if filter_call is not None:
             if not isinstance(filter_call, Call):
@@ -495,9 +555,13 @@ class Executor:
         # limit semantics — is preserved because chunks run in pair
         # order). Shapes pad to powers of two so recompiles stay rare.
         n_shards = len(shards)
+        # floor to a power of two so padded chunks never exceed the
+        # budget (p_pad ≤ chunk_cap), and pow2 shapes keep XLA retraces
+        # to one compile per bucket
         chunk_cap = max(
             1, self.GROUPBY_MASK_BUDGET // (n_shards * WORDS_PER_SHARD * 4)
         )
+        chunk_cap = 1 << (chunk_cap.bit_length() - 1)
 
         def _pow2(n: int) -> int:
             return 1 << max(0, (n - 1)).bit_length()
@@ -556,12 +620,15 @@ class Executor:
                     # counts suffice — skip materializing final masks
                     emit(sub_groups, cnp[chunk[:, 0], chunk[:, 1]], None)
                 else:
+                    # stays p_pad-padded: padding entries are all-zero
+                    # masks (g_idx 0 & row -1 → 0) and count 0, and a
+                    # stable pow2 shape avoids per-G recompiles
                     sub_masks = _gb_masks(
                         masks,
                         matrices[level],
                         jnp.asarray(g_idx),
                         jnp.asarray(row_sel),
-                    )[: chunk.shape[0]]
+                    )
                     if last:
                         emit(
                             sub_groups, cnp[chunk[:, 0], chunk[:, 1]], sub_masks

@@ -163,13 +163,24 @@ class Bitmap:
         key = max(self._containers)
         return (key << 16) | int(ct.as_values(self._containers[key])[-1])
 
+    def _range_keys(self, start: int, stop: int) -> list[int]:
+        """Container keys overlapping [start, stop). For narrow ranges
+        (the per-row hot path — one row spans ≤ SHARD_WIDTH/2^16 + 1
+        containers) this probes candidate keys directly instead of
+        scanning every container: a 100k-row fragment must not pay
+        O(containers) per row access."""
+        first, last = start >> 16, (stop - 1) >> 16
+        if last - first + 1 <= len(self._containers):
+            return [k for k in range(first, last + 1) if k in self._containers]
+        return sorted(
+            k for k in self._containers if first <= k <= last
+        )
+
     def range_count(self, start: int, stop: int) -> int:
         """Count of values in [start, stop)."""
         total = 0
-        for key in self._containers:
+        for key in self._range_keys(start, stop):
             base = key << 16
-            if base >= stop or base + ct.CONTAINER_BITS <= start:
-                continue
             c = self._containers[key]
             if start <= base and base + ct.CONTAINER_BITS <= stop:
                 total += ct.container_count(c)
@@ -185,10 +196,8 @@ class Bitmap:
     def range_values(self, start: int, stop: int) -> np.ndarray:
         """Values in [start, stop), sorted, as uint64 (absolute positions)."""
         parts = []
-        for key in sorted(self._containers):
+        for key in self._range_keys(start, stop):
             base = key << 16
-            if base >= stop or base + ct.CONTAINER_BITS <= start:
-                continue
             vals = ct.as_values(self._containers[key]).astype(np.uint64) + np.uint64(base)
             if start > base or base + ct.CONTAINER_BITS > stop:
                 vals = vals[(vals >= np.uint64(start)) & (vals < np.uint64(stop))]
