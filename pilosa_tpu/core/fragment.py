@@ -163,22 +163,22 @@ class Fragment:
         if self._approx_max_pos >= 0:
             self._approx_max_pos = max(self._approx_max_pos, int(pos))
 
-    def row_ids(self) -> list[int]:
-        """Row IDs with ≥1 bit set. Derived from container keys (each key
-        covers 2^16 positions) — no full scan (reference: fragment.rows)."""
-        keys = np.fromiter(self.bitmap._containers.keys(), dtype=np.int64)
-        if keys.size == 0:
-            return []
-        # each container key covers positions [key<<16, (key+1)<<16); that
-        # span may overlap several rows when SHARD_WIDTH < 2^16
+    def _candidate_rows(self) -> list[int]:
+        """Sorted row IDs that MAY hold bits, derived from container keys
+        (each key covers 2^16 positions; a key's span may overlap several
+        rows when SHARD_WIDTH < 2^16) — no full scan."""
         candidates: set[int] = set()
-        for key in keys.tolist():
+        for key in self.bitmap._containers.keys():
             first = (key << 16) // SHARD_WIDTH
             last = ((key + 1) << 16) - 1
             candidates.update(range(first, last // SHARD_WIDTH + 1))
+        return sorted(candidates)
+
+    def row_ids(self) -> list[int]:
+        """Row IDs with ≥1 bit set (reference: fragment.rows)."""
         return [
             r
-            for r in sorted(candidates)
+            for r in self._candidate_rows()
             if self.bitmap.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
         ]
 
@@ -250,16 +250,19 @@ class Fragment:
             return True
 
     def rows_containing(self, col: int) -> list[int]:
-        """Rows whose bit for ``col`` is set — one O(1) container probe per
-        candidate row instead of per-row range scans (mutex/bool single-
-        value enforcement; reference: fragment mutex handling)."""
+        """Rows whose bit for ``col`` is set (mutex/bool single-value
+        enforcement; reference: fragment mutex handling). Only candidate
+        rows (≥1 bit anywhere) are probed, all through one vectorized
+        ``contains_many`` call — never a Python loop up to n_rows()."""
+        cand = self._candidate_rows()
+        if not cand:
+            return []
         c = col % SHARD_WIDTH
-        out = []
-        for r in range(self.n_rows()):
-            pos = r * SHARD_WIDTH + c
-            if self.bitmap.contains(pos):
-                out.append(r)
-        return out
+        rids = np.asarray(cand, dtype=np.uint64)
+        hit = self.bitmap.contains_many(
+            rids * np.uint64(SHARD_WIDTH) + np.uint64(c)
+        )
+        return [int(r) for r in rids[hit]]
 
     def bulk_import(self, rows: np.ndarray, cols: np.ndarray, clear: bool = False) -> None:
         """Batched set/clear (reference: fragment.bulkImport). ``cols`` are

@@ -317,8 +317,12 @@ class StackCache:
             if stale is None:
                 entry["slots"].clear()
             else:
-                for r in stale & set(entry["slots"]):
-                    self._upload_hot_row(entry, view, shards, r, entry["slots"][r])
+                self._upload_hot_rows(
+                    entry,
+                    view,
+                    shards,
+                    [(r, entry["slots"][r]) for r in stale & set(entry["slots"])],
+                )
             entry["versions"] = versions
         return entry, view
 

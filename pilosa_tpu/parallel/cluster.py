@@ -28,7 +28,7 @@ from typing import Any
 import numpy as np
 
 from pilosa_tpu.executor import RowResult
-from pilosa_tpu.executor.executor import WRITE_CALLS, apply_options
+from pilosa_tpu.executor.executor import WRITE_CALLS, apply_options, unwrap_options
 from pilosa_tpu.parallel.client import (
     InternalClient,
     PeerError,
@@ -346,14 +346,14 @@ class Cluster:
     def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
         self._check_ready()
         calls = parse(pql)
+        api = self.server.api
+        api.check_write_limit(api.count_query_writes(calls), "query")
         results = []
         for call in calls:
             # classify on the innermost call: Options(Set(...)) — however
             # deeply wrapped — must take the write path (replica
             # fan-out), not the read scatter
-            inner = call
-            while inner.name == "Options" and len(inner.children) == 1:
-                inner = inner.children[0]
+            inner = unwrap_options(call)
             if inner.name in WRITE_CALLS:
                 results.append(self._route_write(index, inner))
             else:
@@ -621,6 +621,10 @@ class Cluster:
         idx = self.server.holder.index(index)
         if idx is None:
             raise ValueError(f"index {index!r} not found")
+        # whole-request size check BEFORE key translation or the per-shard
+        # split — per-node slices passing their own check must not let an
+        # oversized request through piecemeal
+        api.check_write_limit(api._payload_size(payload), "import")
         # cluster-consistent key translation through the primary
         if payload.get("columnKeys"):
             payload = dict(payload)

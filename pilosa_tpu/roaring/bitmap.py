@@ -107,8 +107,18 @@ class Bitmap:
         return c is not None and ct.container_contains(c, int(v) & 0xFFFF)
 
     def contains_many(self, values: np.ndarray) -> np.ndarray:
-        """Vectorised membership: bool[len(values)], grouped by container
-        key with one np.isin per touched container."""
+        """Vectorised membership: bool[len(values)].
+
+        Per-touched-container work must stay O(1) *python* ops (no numpy
+        call per container): a single-bit mutex probe on a 100k-row
+        fragment touches ~100k one-element array containers, and anything
+        per-container-vectorised (np.isin, even searchsorted) costs
+        microseconds × 100k. Array containers are therefore answered by
+        ONE searchsorted over their concatenation — tagging every element
+        and query with its container ordinal keeps the concatenation
+        globally sorted. Bitmap containers are scalar word probes; run
+        containers one small searchsorted each (runs are rare).
+        """
         values = np.asarray(values, dtype=np.uint64)
         out = np.zeros(values.size, dtype=bool)
         if values.size == 0 or not self._containers:
@@ -119,12 +129,48 @@ class Bitmap:
         ks = keys[order]
         uniq, starts = np.unique(ks, return_index=True)
         bounds = np.append(starts, ks.size)
+        arr_parts: list[np.ndarray] = []
+        arr_lens: list[int] = []
+        arr_sels: list[np.ndarray] = []
+        get = self._containers.get
         for i, key in enumerate(uniq.tolist()):
-            c = self._containers.get(int(key))
+            c = get(key)
             if c is None:
                 continue
             sel = order[bounds[i] : bounds[i + 1]]
-            out[sel] = np.isin(lows[sel], ct.as_values(c))
+            t = c.type
+            if t == ct.TYPE_ARRAY:
+                arr_parts.append(c.data)
+                arr_lens.append(c.data.size)
+                arr_sels.append(sel)
+            elif t == ct.TYPE_BITMAP:
+                # one vectorized word probe per container — at most
+                # count/4096 bitmap containers exist, and a dense row can
+                # receive the whole query batch (mutex_import's candidate
+                # grid), which must not degrade to per-probe Python
+                q = lows[sel].astype(np.int64)
+                out[sel] = (c.data[q >> 6] >> (q & 63).astype(np.uint64)) & np.uint64(1) != 0
+            else:  # TYPE_RUN — [start, last] inclusive pairs
+                runs = c.data
+                if runs.size:
+                    q = lows[sel]
+                    j = np.searchsorted(runs[:, 0], q, side="right") - 1
+                    jc = np.maximum(j, 0)
+                    out[sel] = (j >= 0) & (q >= runs[jc, 0]) & (q <= runs[jc, 1])
+        if arr_parts:
+            combined = np.concatenate(arr_parts).astype(np.int64)
+            lens = np.asarray(arr_lens, dtype=np.int64)
+            combined |= np.repeat(
+                np.arange(lens.size, dtype=np.int64), lens
+            ) << 17
+            qsel = np.concatenate(arr_sels)
+            qlens = np.asarray([s.size for s in arr_sels], dtype=np.int64)
+            q = lows[qsel].astype(np.int64) | (
+                np.repeat(np.arange(qlens.size, dtype=np.int64), qlens) << 17
+            )
+            pos = np.searchsorted(combined, q)
+            posc = np.minimum(pos, combined.size - 1)
+            out[qsel] = combined[posc] == q
         return out
 
     def count(self) -> int:

@@ -35,6 +35,12 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 _NAME_RE = re.compile(r"^[a-z][a-z0-9_-]{0,63}$")
 
 
+class RequestTooLargeError(ExecutionError):
+    """A single request carries more writes than max_writes_per_request
+    allows (reference: server/config.go max-writes-per-request). The HTTP
+    layer maps this to 413."""
+
+
 def validate_name(name: str, what: str = "name") -> str:
     if not _NAME_RE.fullmatch(name):
         raise ExecutionError(
@@ -60,9 +66,17 @@ def field_options_from_json(opts: dict) -> FieldOptions:
 
 
 class API:
-    def __init__(self, holder: Holder, cluster=None, stats=None, mesh_ctx=None):
+    def __init__(
+        self,
+        holder: Holder,
+        cluster=None,
+        stats=None,
+        mesh_ctx=None,
+        max_writes: int = 5000,
+    ):
         self.holder = holder
         self.cluster = cluster  # None ⇒ single-node
+        self.max_writes = max_writes
         if mesh_ctx == "auto":
             # explicit opt-in: multi-device host ⇒ serve queries as SPMD
             # programs over the device mesh (the reference's mapReduce
@@ -137,10 +151,28 @@ class API:
                     )
 
     # -------------------------------------------------------------- query
+    def check_write_limit(self, n: int, what: str) -> None:
+        if self.max_writes > 0 and n > self.max_writes:
+            raise RequestTooLargeError(
+                f"{what} carries {n} writes; max_writes_per_request is "
+                f"{self.max_writes}"
+            )
+
+    def count_query_writes(self, calls: list) -> int:
+        """Write calls in a parsed query — same classification rule the
+        cluster router uses (executor.unwrap_options)."""
+        from pilosa_tpu.executor.executor import WRITE_CALLS, unwrap_options
+
+        return sum(1 for c in calls if unwrap_options(c).name in WRITE_CALLS)
+
     def query(
         self, index: str, pql: str, shards: list[int] | None = None
     ) -> dict:
-        results = self.executor.execute(index, pql, shards=shards)
+        from pilosa_tpu.pql import parse
+
+        calls = parse(pql) if isinstance(pql, str) else pql
+        self.check_write_limit(self.count_query_writes(calls), "query")
+        results = self.executor.execute(index, calls, shards=shards)
         return self.build_response(results)
 
     def build_response(self, results: list[Any]) -> dict:
@@ -174,6 +206,9 @@ class API:
         """
         idx = self._index(index)
         f = self._field(idx, field)
+        # size-check the raw payload BEFORE key translation so an
+        # oversized keyed import doesn't allocate new IDs first
+        self.check_write_limit(self._payload_size(payload), "import")
         rows = self._resolve_rows(f, payload)
         cols = self._resolve_cols(idx, payload)
         if rows.size != cols.size:
@@ -189,6 +224,7 @@ class API:
         """Bulk BSI import (reference: api.ImportValue)."""
         idx = self._index(index)
         f = self._field(idx, field)
+        self.check_write_limit(self._payload_size(payload), "import")
         cols = self._resolve_cols(idx, payload)
         if payload.get("clear"):
             f.clear_values(cols)
@@ -207,6 +243,16 @@ class API:
         frag = f.create_view_if_not_exists(view).create_fragment_if_not_exists(shard)
         frag.import_roaring(data)
         idx.mark_columns_exist(frag.bitmap.values() % np.uint64(SHARD_WIDTH) + np.uint64(shard * SHARD_WIDTH))
+
+    @staticmethod
+    def _payload_size(payload: dict) -> int:
+        return max(
+            (
+                len(payload.get(k) or [])
+                for k in ("rowIDs", "rowKeys", "columnIDs", "columnKeys", "values")
+            ),
+            default=0,
+        )
 
     def _resolve_rows(self, f: Field, payload: dict) -> np.ndarray:
         if "rowKeys" in payload and payload["rowKeys"]:

@@ -30,6 +30,7 @@ from urllib.parse import parse_qs, urlparse
 from pilosa_tpu import __version__, encoding
 from pilosa_tpu.executor import ExecutionError
 from pilosa_tpu.parallel.topology import ShardUnavailableError
+from pilosa_tpu.server.api import RequestTooLargeError
 from pilosa_tpu.pql import PQLError
 from pilosa_tpu.utils import GLOBAL_TRACER, StatsClient
 
@@ -102,6 +103,8 @@ class Handler(BaseHTTPRequestHandler):
         """Run a route handler with the error→status mapping applied."""
         try:
             return fn(*args)
+        except RequestTooLargeError as e:
+            self._error(str(e), code=413)
         except (ExecutionError, PQLError, ValueError, KeyError) as e:
             self._error(str(e), code=400)
         except ShardUnavailableError as e:

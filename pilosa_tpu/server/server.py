@@ -29,7 +29,12 @@ class Server:
         # backend (seconds, or worse on a wedged transport) — that must
         # not block Server() construction; open() attaches the mesh AFTER
         # the listener is serving (see open()'s ordering rationale)
-        self.api = API(self.holder, stats=self.stats, mesh_ctx=None)
+        self.api = API(
+            self.holder,
+            stats=self.stats,
+            mesh_ctx=None,
+            max_writes=self.config.max_writes_per_request,
+        )
         self.http: HTTPServer | None = None
         self.diagnostics = None
         self._anti_entropy_timer: threading.Timer | None = None

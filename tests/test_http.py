@@ -165,3 +165,39 @@ def test_schema_apply_and_persistence(srv, tmp_path):
     got = call(srv, "GET", "/schema")
     assert got["indexes"][0]["name"] == "i2"
     assert got["indexes"][0]["fields"][0]["options"]["type"] == "int"
+
+
+def test_max_writes_per_request_enforced(tmp_path):
+    """Oversized import payloads and multi-write queries get 413
+    (reference: server/config.go max-writes-per-request)."""
+    s = Server(Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "mw"),
+                      anti_entropy_interval=0, max_writes_per_request=3))
+    s.open()
+    try:
+        call(s, "POST", "/index/i", {})
+        call(s, "POST", "/index/i/field/f", {})
+        call(s, "POST", "/index/i/field/v", {"options": {"type": "int"}})
+        # at the limit: fine
+        call(s, "POST", "/index/i/field/f/import",
+             {"rowIDs": [1, 2, 3], "columnIDs": [1, 2, 3]})
+        # over the limit: 413
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "POST", "/index/i/field/f/import",
+                 {"rowIDs": [1, 2, 3, 4], "columnIDs": [1, 2, 3, 4]})
+        assert e.value.code == 413
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "POST", "/index/i/field/v/import",
+                 {"columnIDs": [1, 2, 3, 4], "values": [9, 9, 9, 9]})
+        assert e.value.code == 413
+        # PQL with too many write calls: 413; reads unaffected
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "POST", "/index/i/query",
+                 b"Set(1, f=1) Set(2, f=1) Set(3, f=1) Set(4, f=1)")
+        assert e.value.code == 413
+        r = call(s, "POST", "/index/i/query", b"Set(9, f=1) Count(Row(f=1))")
+        assert r["results"][0] is True
+        # nothing from the rejected batch landed
+        r = call(s, "POST", "/index/i/query", b"Row(f=1)")
+        assert 4 not in r["results"][0]["columns"]
+    finally:
+        s.close()

@@ -123,9 +123,50 @@ def test_hot_entries_lru_bounded(tight_budget):
     assert len(stacks._hot) <= stacks.MAX_HOT_ENTRIES
 
 
-def test_groupby_over_budget_errors_clearly(tight_budget):
-    h, f, *_ = _high_card_holder(n_rows=5000, n_shards=2)
+def test_groupby_over_budget_streams_exact(tight_budget):
+    """GroupBy on a field whose stack exceeds the device budget must
+    stream row chunks (VERDICT r2 item 4) and stay EXACT — same answer a
+    budget-free executor gives."""
+    h, f, rows, cols, extra_rows, extra_cols = _high_card_holder(
+        n_rows=5000, n_shards=2
+    )
     e = Executor(h)
-    with pytest.raises(ExecutionError) as err:
-        e.execute("hc", "GroupBy(Rows(f))")
-    assert "budget" in str(err.value)
+    got = e.execute("hc", "GroupBy(Rows(f))")[0]
+    counts: dict[int, int] = {}
+    for r in np.concatenate([rows, extra_rows]).tolist():
+        counts[r] = counts.get(r, 0) + 1
+    assert len(got) == len(counts)
+    for entry in got[:50] + got[-50:]:
+        rid = entry["group"][0]["rowID"]
+        assert entry["count"] == counts[rid], rid
+    # output is row-ascending (chunking must not reorder)
+    ids = [entry["group"][0]["rowID"] for entry in got]
+    assert ids == sorted(ids)
+    # limit semantics survive chunking
+    limited = e.execute("hc", "GroupBy(Rows(f), limit=7)")[0]
+    assert [g["group"][0]["rowID"] for g in limited] == ids[:7]
+
+
+def test_groupby_over_budget_nested_with_filter(tight_budget):
+    """Nested GroupBy where the OUTER level streams (over budget) and the
+    inner level is tiny: counts must equal the intersection cardinality."""
+    h = Holder(None)
+    idx = h.create_index("hc")
+    f = idx.create_field("big")
+    g = idx.create_field("small")
+    n = 3000
+    rows = np.arange(n, dtype=np.uint64)
+    cols = np.arange(n, dtype=np.uint64) * 3 % np.uint64(2 * SHARD_WIDTH)
+    f.import_bulk(rows, cols)
+    g.import_bulk((cols % 2).astype(np.uint64), cols)
+    idx.mark_columns_exist(cols)
+    e = Executor(h)
+    res = e.execute("hc", "GroupBy(Rows(big), Rows(small), limit=40)")[0]
+    assert res, "no groups returned"
+    for entry in res:
+        big_r = entry["group"][0]["rowID"]
+        small_r = entry["group"][1]["rowID"]
+        expect = int(
+            np.count_nonzero((rows == big_r) & (cols % 2 == small_r))
+        )
+        assert entry["count"] == expect, (big_r, small_r)
