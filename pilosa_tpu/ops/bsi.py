@@ -20,6 +20,7 @@ Python loops below unroll into straight-line XLA ops.
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from pilosa_tpu.ops.bitwise import matrix_filter_counts, popcount, popcount_rows
 
@@ -27,7 +28,10 @@ EXISTS_ROW = 0
 SIGN_ROW = 1
 OFFSET_ROW = 2
 
-_ONES = jnp.uint32(0xFFFFFFFF)
+# numpy, not jnp: a module-level jnp scalar would initialize the XLA
+# backend at import, which forbids a later jax.distributed.initialize
+# (multi-host servers import this module long before joining the group)
+_ONES = np.uint32(0xFFFFFFFF)
 
 
 def _magnitude_cmp(mag, c_abs: int):

@@ -67,8 +67,15 @@ class MeshContext:
     keep plain device arrays.
     """
 
-    def __init__(self, mesh: Mesh):
+    def __init__(self, mesh: Mesh, multihost: bool = False):
         self.mesh = mesh
+        # multihost: the mesh spans >1 process. Host arrays are then
+        # placed with jax.make_array_from_process_local_data — each
+        # process contributes ITS addressable slice of the global array
+        # (its owned shards), so a psum over the mesh is a GLOBAL
+        # reduction with no HTTP merge. Requires every process to run the
+        # same program in lockstep (jax.distributed SPMD contract).
+        self.multihost = multihost
 
     @classmethod
     def auto(cls, words_axis: int = 1, devices=None) -> "MeshContext | None":
@@ -103,17 +110,57 @@ class MeshContext:
             return P(None, *middle, (AXIS_SHARDS, AXIS_WORDS))
         return P()
 
+    def _check_uniform_s(self, s: int) -> None:
+        """Global shape is ``s × process_count``, which is only coherent
+        when every process contributes the SAME shard count — topology
+        does not guarantee that (5 shards over 2 hosts), and a mismatch
+        would hang the next collective with no diagnostic. One allgather
+        per distinct S validates it across the group (cached after)."""
+        validated = getattr(self, "_validated_s", None)
+        if validated is None:
+            validated = self._validated_s = set()
+        if s in validated:
+            return
+        from jax.experimental import multihost_utils
+
+        counts = np.asarray(multihost_utils.process_allgather(np.int64(s)))
+        if not (counts == s).all():
+            raise ValueError(
+                f"multi-host placement needs a uniform per-process shard "
+                f"count; got {counts.tolist()} — pad every process to the "
+                "same S (empty shards are all-zero rows)"
+            )
+        validated.add(s)
+
+    def _place(self, arr, middle_dims: int):
+        s = arr.shape[0]
+        w = arr.shape[-1]
+        if self.multihost:
+            n_proc = jax.process_count()
+            self._check_uniform_s(s)
+            s_global = s * n_proc
+            spec = self._spec(s_global, w, middle_dims)
+            if len(spec) == 0 or spec[0] != AXIS_SHARDS:
+                raise ValueError(
+                    f"multi-host placement needs the shards axis sharded: "
+                    f"global S={s_global} not divisible by mesh "
+                    f"{self.mesh.shape[AXIS_SHARDS]} shard rows"
+                )
+            global_shape = (s_global,) + arr.shape[1:]
+            return jax.make_array_from_process_local_data(
+                NamedSharding(self.mesh, spec), arr, global_shape
+            )
+        return jax.device_put(arr, NamedSharding(self.mesh, self._spec(s, w, middle_dims)))
+
     def place_stack(self, stacked):
-        """uint32[S, R, W] (or [S, D, W] BSI block) → sharded device array."""
-        s, _, w = stacked.shape
-        return jax.device_put(
-            stacked, NamedSharding(self.mesh, self._spec(s, w, 1))
-        )
+        """uint32[S, R, W] (or [S, D, W] BSI block) → sharded device array.
+        Multi-host: S is this process's shard count; the global array
+        concatenates every process's slice along S."""
+        return self._place(stacked, 1)
 
     def place_rows(self, arr):
         """uint32[S, W] → sharded device array."""
-        s, w = arr.shape
-        return jax.device_put(arr, NamedSharding(self.mesh, self._spec(s, w, 0)))
+        return self._place(arr, 0)
 
 
 class MeshQueryEngine:

@@ -1,20 +1,34 @@
 """Roaring persistence: snapshot file format + append-only ops log.
 
 Reference: roaring/roaring.go (WriteTo/UnmarshalBinary with the
-pilosa-specific cookie, and the appended ops log: op / OpWriter). The byte
-layout here is this framework's own (the reference mount was empty so
-byte-compatibility could not be verified — see SURVEY.md §0), but the
-structure mirrors the reference: a header cookie, per-container metadata
-(key, type, cardinality), offsets, payloads, then zero or more ops appended
-after the snapshot which are replayed on load.
+pilosa-specific cookie, and the appended ops log: op / OpWriter).
 
-Layout (little-endian):
+Two snapshot layouts are readable; the upstream-pilosa layout is the one
+written (VERDICT r2 item 10 — wire interop with stock pilosa clients'
+``import-roaring`` payloads and fragment files). Both start with the
+uint16 magic 12348; the next uint16 distinguishes them (0 = upstream
+storageVersion, 1 = this framework's round-1 layout).
+
+Upstream layout (little-endian; roaring.go WriteTo — reconstructed from
+upstream v1.x knowledge, unverified against the fork because the
+reference mount is empty, see SURVEY.md §0):
+    cookie:   uint32 = 12348 | storageVersion(0) << 16
+    count:    uint32 n_containers
+    headers:  n × (uint64 key | uint16 type | uint16 cardinality-1)
+              type: 1=array, 2=bitmap, 3=run
+    offsets:  n × uint32 (byte offset of payload from buffer start)
+    payloads: array: card×uint16; bitmap: 1024×uint64;
+              run: uint16 n_runs, then n_runs×(uint16 start|uint16 last)
+
+Legacy layout (round 1, still readable):
     header:   uint16 magic=12348 | uint16 version=1 | uint32 n_containers
     metadata: n × (uint64 key | uint16 type | uint16 pad | uint32 cardinality)
-    offsets:  n × uint64 (byte offset of payload from file start)
-    payloads: array: n×uint16; bitmap: 1024×uint64; run: n_runs×(2×uint16),
-              run payload prefixed by uint32 n_runs
-    ops log:  repeated (uint8 magic=0xF1 | uint8 opcode | uint32 count |
+    offsets:  n × uint64
+    payloads: as above except runs prefixed by uint32 n_runs
+
+Ops log (framework-specific; appended after either snapshot, replayed on
+load — upstream's op byte layout is version-dependent and unverifiable):
+    repeated (uint8 magic=0xF1 | uint8 opcode | uint32 count |
               count × uint64 values) — opcode 1=add, 2=remove
 """
 
@@ -29,7 +43,8 @@ from pilosa_tpu.roaring import containers as ct
 from pilosa_tpu.roaring.bitmap import Bitmap
 
 MAGIC = 12348
-VERSION = 1  # v1: uint64 payload offsets (v0 used uint32)
+STORAGE_VERSION = 0  # upstream pilosa storageVersion (written format)
+VERSION = 1  # this framework's round-1 layout (read-compat only)
 OP_MAGIC = 0xF1
 OP_ADD = 1
 OP_REMOVE = 2
@@ -37,27 +52,31 @@ OP_REMOVE = 2
 _HEADER = struct.Struct("<HHI")
 _META = struct.Struct("<QHHI")
 _OP_HEADER = struct.Struct("<BBI")
+_PILOSA_HEADER = struct.Struct("<II")  # cookie, container count
+_PILOSA_META = struct.Struct("<QHH")  # key, type, cardinality-1
+
+
+def _payload_bytes(c: ct.Container) -> bytes:
+    if c.type == ct.TYPE_RUN:
+        return struct.pack("<H", c.data.shape[0]) + c.data.tobytes()
+    return c.data.tobytes()
 
 
 def serialize(bitmap: Bitmap) -> bytes:
-    """Snapshot a Bitmap to bytes (no ops log)."""
+    """Snapshot a Bitmap to bytes (no ops log) in the upstream-pilosa
+    layout (roaring.go WriteTo)."""
     keys = sorted(bitmap._containers)
     buf = io.BytesIO()
-    buf.write(_HEADER.pack(MAGIC, VERSION, len(keys)))
+    cookie = MAGIC | (STORAGE_VERSION << 16)
+    buf.write(_PILOSA_HEADER.pack(cookie, len(keys)))
     payloads = []
     for key in keys:
         c = bitmap._containers[key]
-        if c.type == ct.TYPE_ARRAY:
-            payload = c.data.tobytes()
-        elif c.type == ct.TYPE_BITMAP:
-            payload = c.data.tobytes()
-        else:
-            payload = struct.pack("<I", c.data.shape[0]) + c.data.tobytes()
-        payloads.append(payload)
-        buf.write(_META.pack(key, c.type, 0, ct.container_count(c)))
-    offset = _HEADER.size + len(keys) * (_META.size + 8)
+        payloads.append(_payload_bytes(c))
+        buf.write(_PILOSA_META.pack(key, c.type, ct.container_count(c) - 1))
+    offset = _PILOSA_HEADER.size + len(keys) * (_PILOSA_META.size + 4)
     for payload in payloads:
-        buf.write(struct.pack("<Q", offset))
+        buf.write(struct.pack("<I", offset))
         offset += len(payload)
     for payload in payloads:
         buf.write(payload)
@@ -67,21 +86,61 @@ def serialize(bitmap: Bitmap) -> bytes:
 def deserialize(data: bytes) -> tuple[Bitmap, int]:
     """Parse a snapshot; returns (bitmap, bytes consumed by the snapshot).
 
-    Any bytes after the snapshot are expected to be ops-log records; use
+    Dispatches on the version word after the shared magic: upstream
+    pilosa layout (storageVersion 0) or this framework's legacy layout
+    (version 1). Any bytes after the snapshot are ops-log records; use
     ``replay_ops`` on the remainder.
     """
     try:
-        return _deserialize(data)
+        magic, version, _n = _HEADER.unpack_from(data, 0)
+        if magic != MAGIC:
+            raise ValueError(f"bad roaring magic {magic}")
+        if version == STORAGE_VERSION:
+            return _deserialize_pilosa(data)
+        if version == VERSION:
+            return _deserialize_legacy(data)
+        raise ValueError(f"unsupported roaring version {version}")
     except struct.error as e:
         raise ValueError(f"truncated roaring snapshot: {e}") from e
 
 
-def _deserialize(data: bytes) -> tuple[Bitmap, int]:
-    magic, version, n = _HEADER.unpack_from(data, 0)
-    if magic != MAGIC:
-        raise ValueError(f"bad roaring magic {magic}")
-    if version != VERSION:
-        raise ValueError(f"unsupported roaring version {version}")
+def _deserialize_pilosa(data: bytes) -> tuple[Bitmap, int]:
+    _cookie, n = _PILOSA_HEADER.unpack_from(data, 0)
+    b = Bitmap()
+    meta_off = _PILOSA_HEADER.size
+    metas = []
+    for i in range(n):
+        key, ctype, card_m1 = _PILOSA_META.unpack_from(
+            data, meta_off + i * _PILOSA_META.size
+        )
+        metas.append((key, ctype, card_m1 + 1))
+    off_base = meta_off + n * _PILOSA_META.size
+    offsets = [
+        struct.unpack_from("<I", data, off_base + 4 * i)[0] for i in range(n)
+    ]
+    end = off_base + 4 * n
+    for (key, ctype, card), off in zip(metas, offsets):
+        if ctype == ct.TYPE_ARRAY:
+            size = card * 2
+            c = ct.array_container(np.frombuffer(data, np.uint16, card, off))
+        elif ctype == ct.TYPE_BITMAP:
+            size = ct.BITMAP_N * 8
+            c = ct.bitmap_container(np.frombuffer(data, np.uint64, ct.BITMAP_N, off))
+        elif ctype == ct.TYPE_RUN:
+            (n_runs,) = struct.unpack_from("<H", data, off)
+            size = 2 + n_runs * 4
+            c = ct.run_container(
+                np.frombuffer(data, np.uint16, n_runs * 2, off + 2).reshape(-1, 2)
+            )
+        else:
+            raise ValueError(f"bad container type {ctype}")
+        b._containers[key] = ct.Container(c.type, c.data.copy())
+        end = max(end, off + size)
+    return b, end
+
+
+def _deserialize_legacy(data: bytes) -> tuple[Bitmap, int]:
+    _magic, _version, n = _HEADER.unpack_from(data, 0)
     b = Bitmap()
     meta_off = _HEADER.size
     metas = []

@@ -57,6 +57,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/debug/pprof/profile$"), "pprof_profile"),
+    ("GET", re.compile(r"^/debug/pprof/goroutine$"), "pprof_goroutine"),
+    ("GET", re.compile(r"^/debug/pprof/heap$"), "pprof_heap"),
     ("GET", re.compile(r"^/export$"), "export"),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
 ]
@@ -342,6 +345,24 @@ class Handler(BaseHTTPRequestHandler):
 
     def h_debug_traces(self) -> None:
         self._json({"spans": GLOBAL_TRACER.recent()})
+
+    # /debug/pprof analogue (reference: net/http/pprof in http/handler.go)
+    def h_pprof_profile(self) -> None:
+        from pilosa_tpu.utils import profiling
+
+        seconds = float(self.query_params.get("seconds", ["5"])[0])
+        self._text(profiling.sample_profile(seconds), content_type="text/plain")
+
+    def h_pprof_goroutine(self) -> None:
+        from pilosa_tpu.utils import profiling
+
+        self._text(profiling.thread_dump(), content_type="text/plain")
+
+    def h_pprof_heap(self) -> None:
+        from pilosa_tpu.utils import profiling
+
+        top = int(self.query_params.get("top", ["50"])[0])
+        self._json(profiling.heap_profile(top))
 
     def h_export(self) -> None:
         index = self.query_params.get("index", [None])[0]

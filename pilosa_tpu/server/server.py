@@ -63,12 +63,20 @@ class Server:
             # client could be silently served local-only (and peers 404)
             self.cluster.attach()
         self.http.serve_background()
-        if self.config.mesh_enabled:
-            from pilosa_tpu.parallel.mesh import MeshContext
+        if self.config.coordinator_address:
+            # join the static jax.distributed process group BEFORE any
+            # other backend use (reference analogue: gossip join); the
+            # listener is already serving so peers' health probes succeed
+            # while this blocks on the coordinator barrier
+            from pilosa_tpu.parallel import multihost
 
-            self.api.attach_mesh(
-                MeshContext.auto(words_axis=self.config.mesh_words_axis)
+            multihost.init_distributed(
+                self.config.coordinator_address,
+                self.config.num_processes or None,
+                self.config.process_id if self.config.process_id >= 0 else None,
             )
+        if self.config.mesh_enabled:
+            self.api.attach_mesh(self._make_mesh_context())
         if self.cluster is not None:
             self.cluster.join()
         self._schedule_anti_entropy()
@@ -77,6 +85,22 @@ class Server:
         self.diagnostics = DiagnosticsCollector(self)
         self.api.diagnostics = self.diagnostics
         self.diagnostics.open()
+
+    def _make_mesh_context(self):
+        """Serving mesh: always over this process's LOCAL devices — even
+        in a multi-host deployment. A global (cross-process) mesh program
+        is a collective: every process must enter it in lockstep, and the
+        HTTP query path is driven by whichever node a client happens to
+        hit, so attaching a global mesh here would hang the first query
+        in a DCN psum waiting for peers that never dispatch it. Cross-
+        host queries therefore scatter-gather through parallel.cluster
+        (each node reducing over its local mesh), while the global-mesh
+        data plane (MeshContext(multihost=True) + MeshQueryEngine) is for
+        symmetric SPMD drivers — every process running the same program —
+        as in tests/test_multihost.py's two-process Count."""
+        from pilosa_tpu.parallel.mesh import MeshContext
+
+        return MeshContext.auto(words_axis=self.config.mesh_words_axis)
 
     def _schedule_anti_entropy(self) -> None:
         interval = self.config.anti_entropy_interval

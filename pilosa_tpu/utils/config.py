@@ -31,6 +31,18 @@ class Config:
     # device mesh (serving-path SPMD over all local devices)
     mesh_enabled: bool = True
     mesh_words_axis: int = 1  # >1 splits the packed word dim across devices
+    # multi-host process group (jax.distributed; reference analogue:
+    # gossip seeds — here membership is static). Setting
+    # coordinator_address makes Server.open() join the group before any
+    # backend init; with >1 process the serving mesh spans all hosts via
+    # multihost.make_multihost_mesh (words axis stays within one host's
+    # ICI). Recipe, on each host h of N:
+    #   coordinator_address = "host0:8476"
+    #   num_processes = N
+    #   process_id = h
+    coordinator_address: str = ""
+    num_processes: int = 0  # 0 = let jax.distributed infer
+    process_id: int = -1  # -1 = let jax.distributed infer
     # metrics
     metric_service: str = "prometheus"
 

@@ -27,6 +27,14 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "width20: production shard-width e2e suite; launch as "
+        "PILOSA_TPU_SHARD_WIDTH_EXP=20 pytest -m width20 tests/test_width20.py",
+    )
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

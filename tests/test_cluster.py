@@ -610,3 +610,87 @@ def test_keys_translation_cluster_consistent(tmp_path):
             assert sorted(r["results"][0]["keys"]) == ["alice", "bob"]
     finally:
         shutdown(servers)
+
+
+def test_dead_peer_probes_off_read_path(tmp_path):
+    """With one dead (hung, not refusing) peer, an uncached shard scan +
+    Count must not synchronously re-probe it (VERDICT r2 item 7): reads
+    route on heartbeat state; probes belong to the background ticker."""
+    import time
+
+    servers, ports, seeds = make_cluster(tmp_path, n=3, replica_n=2, start={0, 1})
+    hole = None
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 1 for s in range(6)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 6, "columnIDs": cols})
+        # warm the program cache so the timed section measures routing
+        assert call(ports[0], "POST", "/index/i/query",
+                    b"Count(Row(f=1))")["results"] == [6]
+
+        # node 2's port now ACCEPTS but never answers — the failure mode
+        # where a synchronous probe costs its full timeout
+        hole = socket.socket()
+        hole.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        hole.bind(("127.0.0.1", ports[2]))
+        hole.listen(1)
+
+        c0 = servers[0].cluster
+        assert not [n for n in c0.nodes if n.uri.endswith(str(ports[2]))][0].alive
+        c0._known_shards.clear()  # force an uncached global_shards scan
+
+        probed = []
+        orig_status = type(c0.client).status
+
+        def counting_status(self, uri, timeout=None):
+            probed.append(uri)
+            return orig_status(self, uri, timeout=timeout)
+
+        type(c0.client).status = counting_status
+        try:
+            t0 = time.perf_counter()
+            r = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+            elapsed = time.perf_counter() - t0
+        finally:
+            type(c0.client).status = orig_status
+        assert r["results"] == [6]
+        dead_uri = f"http://127.0.0.1:{ports[2]}"
+        assert dead_uri not in probed, "read path synchronously probed a dead peer"
+        assert elapsed < 2.0, f"read with one dead peer took {elapsed:.2f}s"
+    finally:
+        if hole is not None:
+            hole.close()
+        shutdown(servers)
+
+
+def test_dead_sole_owner_errors_not_partial(tmp_path):
+    """replica_n=1, sole owner of some shards dies, coordinator's scan
+    cache is cold: the query must FAIL (503), never silently return a
+    partial count — dead peers' last-reported shards stay in the scan."""
+    servers, ports, seeds = make_cluster(tmp_path, n=2, replica_n=1)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 1 for s in range(4)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 4, "columnIDs": cols})
+        assert call(ports[0], "POST", "/index/i/query",
+                    b"Count(Row(f=1))")["results"] == [4]
+        # node 1 owns at least one shard exclusively
+        c0 = servers[0].cluster
+        owned_by_1 = [s for s in range(4)
+                      if not c0.topology.owns(c0.me.id, "i", s)]
+        assert owned_by_1, "topology gave node 0 everything; widen shards"
+        # kill node 1; mark dead; cold-start the shard scan cache
+        servers[1].close()
+        servers[1] = None
+        c0._heartbeat_once()
+        c0._known_shards.clear()
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert e.value.code == 503
+    finally:
+        shutdown(servers)

@@ -42,14 +42,20 @@ def call(port, method, path, body=None, timeout=120):
 
 def wait_ready(port, deadline=360.0):
     # generous: 3 JAX subprocesses importing concurrently on a 1-CPU CI
-    # box take >100s wall before the first one binds its socket
+    # box take >100s wall before the first one binds its socket. Wait for
+    # NORMAL, not just a listening socket — a STARTING node 503s queries
+    # and imports (cluster._check_ready), which is correct behavior, not
+    # readiness.
     t0 = time.time()
     while time.time() - t0 < deadline:
         try:
-            return call(port, "GET", "/status", timeout=5)
+            st = call(port, "GET", "/status", timeout=5)
+            if st.get("state") == "NORMAL":
+                return st
         except (urllib.error.URLError, OSError):
-            time.sleep(0.3)
-    raise TimeoutError(f"server on :{port} did not come up")
+            pass
+        time.sleep(0.3)
+    raise TimeoutError(f"server on :{port} did not come up NORMAL")
 
 
 @pytest.fixture
@@ -108,21 +114,27 @@ def test_subprocess_cluster_end_to_end(procs):
         r = call(p, "POST", "/index/i/query", b"Count(Row(f=1))")
         assert r["results"] == [4]
 
-    # kill node 2 with replica_n=2: remaining nodes serve the full data
+    # kill node 2 with replica_n=2: remaining nodes serve the full data.
+    # Each survivor's FIRST query that routes to the dead peer fails 503
+    # (read routing is heartbeat-state-based; the failed RPC marks the
+    # peer dead and the next query reroutes to a replica) — so converge
+    # each node in its own retry loop before the hard assert.
     running[2].kill()
     running[2].wait(timeout=20)
+    results = {}
     deadline = time.time() + 60
-    while time.time() < deadline:
-        try:
-            if call(ports[0], "POST", "/index/i/query",
-                    b"Count(Row(f=1))")["results"] == [4]:
-                break
-        except (urllib.error.URLError, OSError):
-            pass
+    while time.time() < deadline and len(results) < 2:
+        for p in (ports[0], ports[1]):
+            if p in results:
+                continue
+            try:
+                if call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [4]:
+                    results[p] = True
+            except (urllib.error.URLError, OSError):
+                pass
         time.sleep(1.0)
-    r0 = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
-    r1 = call(ports[1], "POST", "/index/i/query", b"Count(Row(f=1))")
-    assert r0["results"] == [4] and r1["results"] == [4]
+    assert len(results) == 2, f"nodes serving after kill: {sorted(results)}"
     # heartbeat marks the cluster degraded
     deadline = time.time() + 30
     state = None

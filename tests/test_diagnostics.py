@@ -10,6 +10,18 @@ from pilosa_tpu.server import Server
 from pilosa_tpu.utils.config import Config
 
 
+def call(srv, method, path, body=None, raw=False):
+    import urllib.request
+
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(req) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or b"{}")
+
+
 @pytest.fixture
 def srv(tmp_path):
     s = Server(
@@ -79,3 +91,30 @@ def test_generate_config_subcommand(capsys):
     assert cfg["bind"] == "127.0.0.1:10101"
     assert cfg["diagnostics-interval"] == 3600.0
     assert cfg["long-query-time"] == 0.0
+
+
+def test_pprof_profile_endpoint(srv):
+    """/debug/pprof/profile samples all threads into folded-stack text
+    (flamegraph input) — the reference's net/http/pprof analogue."""
+    raw = call(srv, "GET", "/debug/pprof/profile?seconds=0.3", raw=True).decode()
+    assert raw.startswith("#") and "samples over" in raw
+    # the HTTP serving thread itself must appear in some stack
+    assert ";" in raw or len(raw.splitlines()) >= 1
+
+
+def test_pprof_goroutine_endpoint(srv):
+    raw = call(srv, "GET", "/debug/pprof/goroutine", raw=True).decode()
+    assert "--- " in raw and "File " not in raw[:4]
+    # at least the main + HTTP threads
+    assert raw.count("--- ") >= 2
+
+
+def test_pprof_heap_endpoint(srv):
+    first = call(srv, "GET", "/debug/pprof/heap")
+    assert "startedAt" in first
+    # second call returns real allocation sites
+    import numpy as _np
+    _keep = _np.zeros(200_000, dtype=_np.uint8)
+    second = call(srv, "GET", "/debug/pprof/heap?top=10")
+    assert second["currentBytes"] > 0
+    assert len(second["top"]) <= 10

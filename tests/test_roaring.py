@@ -184,3 +184,68 @@ def test_deserialize_truncated_raises_valueerror(rng):
     for cut in (1, 6, 10, len(data) - 3):
         with pytest.raises(ValueError):
             roaring.deserialize(data[:cut])
+
+
+def test_pilosa_cookie_format_roundtrip():
+    """Snapshots are written in the upstream-pilosa layout (cookie 12348 |
+    storageVersion 0) and round-trip all three container types
+    (reference: roaring.go WriteTo/UnmarshalBinary)."""
+    import struct
+
+    rng = np.random.default_rng(12)
+    b = roaring.Bitmap()
+    b.add_many(rng.choice(1 << 16, size=500, replace=False).astype(np.uint64))  # array
+    b.add_many((np.uint64(1 << 16) + rng.choice(1 << 16, size=30_000, replace=False).astype(np.uint64)))  # bitmap
+    b.add_many(np.arange(3 << 16, (3 << 16) + 9000, dtype=np.uint64))  # run
+    data = roaring.serialize(b)
+    cookie, n = struct.unpack_from("<II", data, 0)
+    assert cookie & 0xFFFF == 12348
+    assert cookie >> 16 == 0  # upstream storageVersion
+    assert n == len(b._containers)
+    got, consumed = roaring.deserialize(data)
+    assert consumed == len(data)
+    assert got == b
+    # container types survived
+    types = sorted(c.type for c in got._containers.values())
+    assert types == sorted(c.type for c in b._containers.values())
+
+
+def test_legacy_snapshot_still_loads():
+    """Round-1 snapshots (version word 1) remain readable."""
+    from pilosa_tpu.roaring import serialize as ser_mod
+
+    b = roaring.Bitmap.from_values(np.array([1, 70000, 1 << 20], dtype=np.uint64))
+    # re-create the legacy writer inline: header v1 + meta + u64 offsets
+    import io, struct
+
+    keys = sorted(b._containers)
+    buf = io.BytesIO()
+    buf.write(struct.pack("<HHI", 12348, 1, len(keys)))
+    payloads = []
+    for key in keys:
+        c = b._containers[key]
+        payloads.append(c.data.tobytes())
+        buf.write(struct.pack("<QHHI", key, c.type, 0, len(c.data)))
+    offset = 8 + len(keys) * (16 + 8)
+    for p in payloads:
+        buf.write(struct.pack("<Q", offset))
+        offset += len(p)
+    for p in payloads:
+        buf.write(p)
+    got, consumed = roaring.deserialize(buf.getvalue())
+    assert got == b and consumed == len(buf.getvalue())
+
+
+def test_pilosa_format_through_import_roaring():
+    """A pilosa-layout payload unions straight into a fragment
+    (reference: fragment.importRoaring fast path)."""
+    from pilosa_tpu.core import Holder
+
+    h = Holder(None)
+    idx = h.create_index("ir")
+    f = idx.create_field("f")
+    vals = np.array([5, 9, (1 << 16) + 3], dtype=np.uint64)  # row 0 + row 1
+    payload = roaring.serialize(roaring.Bitmap.from_values(vals))
+    frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
+    frag.import_roaring(payload)
+    assert frag.contains(0, 5) and frag.contains(0, 9) and frag.contains(1, 3)
