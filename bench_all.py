@@ -124,31 +124,59 @@ def config2_multi_shard_setops():
 
 
 def config3_topn_groupby():
-    import jax
-
-    from pilosa_tpu import ops
-    from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+    """Taxi-style categorical dataset THROUGH THE EXECUTOR: TopN over a
+    256-row field and a nested two-field GroupBy, both as PQL (the
+    reference's canonical demo shape: cab_type × passenger_count)."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.executor import Executor
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
 
     rng = np.random.default_rng(2)
-    rows, shards = 256, 32  # e.g. 256 cab/vendor categories
-    matrix = rng.integers(0, 2**32, (shards, rows, WORDS_PER_SHARD), dtype=np.uint32)
-    filt = rng.integers(0, 2**32, (shards, WORDS_PER_SHARD), dtype=np.uint32)
-    dm, df = jax.device_put(matrix), jax.device_put(filt)
+    shards = int(os.environ.get("PILOSA_BENCH_TAXI_SHARDS", "8"))
+    n_trips = shards * SHARD_WIDTH
+    h = Holder(None)
+    idx = h.create_index("taxi")
+    cab = idx.create_field("cab_type")
+    pc = idx.create_field("passenger_count")
+    cols = np.arange(n_trips, dtype=np.uint64)
+    cab_rows = rng.integers(0, 256, n_trips).astype(np.uint64)  # 256 fleets
+    pc_rows = rng.integers(1, 7, n_trips).astype(np.uint64)
+    for lo in range(0, n_trips, SHARD_WIDTH):  # per-shard batched import
+        cab.import_bulk(cab_rows[lo : lo + SHARD_WIDTH], cols[lo : lo + SHARD_WIDTH])
+        pc.import_bulk(pc_rows[lo : lo + SHARD_WIDTH], cols[lo : lo + SHARD_WIDTH])
+    idx.mark_columns_exist(cols)
+    e = Executor(h)
 
-    @jax.jit
-    def dev(m, f):
-        counts = ops.popcount_rows(m & f[:, None, :]).sum(axis=0)
-        return jax.lax.top_k(counts, 10)
-
-    def host():
-        counts = np.bitwise_count(matrix & filt[:, None, :]).sum(axis=(0, 2))
+    # host baseline: the same aggregations over the raw column arrays
+    def host_topn():
+        counts = np.bincount(cab_rows.astype(np.int64), minlength=256)
         return np.argsort(-counts)[:10]
 
-    vals, ids = dev(dm, df)
-    assert set(np.asarray(ids).tolist()) == set(host().tolist())
-    t_dev = timeit(lambda: dev(dm, df)[0], 20)
-    t_host = timeit(host, 3)
-    line("topn_groupby_qps", 1 / t_dev, "qps", t_host / t_dev)
+    got = e.execute("taxi", "TopN(cab_type, n=10)")[0]
+    want_counts = np.bincount(cab_rows.astype(np.int64), minlength=256)
+    assert [p["count"] for p in got] == sorted(want_counts.tolist(), reverse=True)[:10]
+    t_topn = timeit(lambda: e.execute("taxi", "TopN(cab_type, n=10)"), 10)
+    t_host = timeit(host_topn, 10)
+    line("executor_topn_qps", 1 / t_topn, "qps", t_host / t_topn)
+
+    def host_groupby():
+        return np.bincount((cab_rows * 8 + pc_rows).astype(np.int64), minlength=2048)
+
+    gb = e.execute(
+        "taxi", "GroupBy(Rows(cab_type), Rows(passenger_count), limit=100)"
+    )[0]
+    hg = host_groupby()
+    for entry in gb[:20]:
+        c, p = entry["group"][0]["rowID"], entry["group"][1]["rowID"]
+        assert entry["count"] == int(hg[c * 8 + p]), (c, p)
+    t_gb = timeit(
+        lambda: e.execute(
+            "taxi", "GroupBy(Rows(cab_type), Rows(passenger_count), limit=100)"
+        ),
+        5,
+    )
+    t_hgb = timeit(host_groupby, 10)
+    line("executor_groupby_qps", 1 / t_gb, "qps", t_hgb / t_gb)
 
 
 def config4_bsi_sum_range():

@@ -114,13 +114,11 @@ class MeshContext:
         """Global shape is ``s × process_count``, which is only coherent
         when every process contributes the SAME shard count — topology
         does not guarantee that (5 shards over 2 hosts), and a mismatch
-        would hang the next collective with no diagnostic. One allgather
-        per distinct S validates it across the group (cached after)."""
-        validated = getattr(self, "_validated_s", None)
-        if validated is None:
-            validated = self._validated_s = set()
-        if s in validated:
-            return
+        would hang the next collective with no diagnostic. Unconditional
+        (never cached): _place is itself collective under the lockstep
+        contract, and a per-value cache would desynchronize the group the
+        first time one process's S diverges (the cached side would skip
+        the allgather the other side enters)."""
         from jax.experimental import multihost_utils
 
         counts = np.asarray(multihost_utils.process_allgather(np.int64(s)))
@@ -130,7 +128,6 @@ class MeshContext:
                 f"count; got {counts.tolist()} — pad every process to the "
                 "same S (empty shards are all-zero rows)"
             )
-        validated.add(s)
 
     def _place(self, arr, middle_dims: int):
         s = arr.shape[0]

@@ -640,6 +640,14 @@ def test_dead_peer_probes_off_read_path(tmp_path):
         c0 = servers[0].cluster
         assert not [n for n in c0.nodes if n.uri.endswith(str(ports[2]))][0].alive
         c0._known_shards.clear()  # force an uncached global_shards scan
+        # stop BOTH nodes' background heartbeat tickers: they legitimately
+        # probe the dead peer, and the class-level patch below must count
+        # only read-path probes
+        for s in servers[:2]:
+            s.cluster._closed = True
+            if s.cluster._hb_timer is not None:
+                s.cluster._hb_timer.cancel()
+        time.sleep(0.1)  # let any in-flight tick drain
 
         probed = []
         orig_status = type(c0.client).status

@@ -98,8 +98,12 @@ def test_pprof_profile_endpoint(srv):
     (flamegraph input) — the reference's net/http/pprof analogue."""
     raw = call(srv, "GET", "/debug/pprof/profile?seconds=0.3", raw=True).decode()
     assert raw.startswith("#") and "samples over" in raw
-    # the HTTP serving thread itself must appear in some stack
-    assert ";" in raw or len(raw.splitlines()) >= 1
+    # the sampler excludes its own (handler) thread, but this in-process
+    # server always has others alive — pytest's main thread blocked in
+    # urlopen, the serve_forever thread — so ≥1 folded stack must appear
+    stacks = [l for l in raw.splitlines()[1:] if l.strip()]
+    assert stacks, "profile sampled no thread stacks"
+    assert all(l.rsplit(" ", 1)[1].isdigit() for l in stacks)
 
 
 def test_pprof_goroutine_endpoint(srv):

@@ -79,7 +79,7 @@ proc_id = int(sys.argv[1]); port = sys.argv[2]
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
 os.environ["PILOSA_TPU_SHARD_WIDTH_EXP"] = "16"
-sys.path.insert(0, os.path.dirname(os.getcwd()))  # launched with cwd=<repo>/tests
+sys.path.insert(0, os.environ["PILOSA_TPU_REPO_ROOT"])
 import numpy as np
 import jax
 from pilosa_tpu.parallel import multihost
@@ -134,12 +134,17 @@ def test_two_process_distributed_count(tmp_path):
     # strip TPU-plugin env: the box's sitecustomize initializes the PJRT
     # backend at interpreter start when these are set, which forbids a
     # later jax.distributed.initialize in the child
+    import os
+
     env = {
         k: v
-        for k, v in __import__("os").environ.items()
+        for k, v in os.environ.items()
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS", "JAX_NUM_PROCESSES")
         and not k.startswith(("PALLAS_AXON", "AXON_", "TPU_"))
     }
+    env["PILOSA_TPU_REPO_ROOT"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    )
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), str(i), str(port)],
@@ -147,7 +152,6 @@ def test_two_process_distributed_count(tmp_path):
             stderr=subprocess.STDOUT,
             text=True,
             env=env,
-            cwd="/root/repo/tests",
         )
         for i in range(2)
     ]
