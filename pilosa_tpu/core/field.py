@@ -29,6 +29,18 @@ from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.core.view import VIEW_BSI, VIEW_STANDARD, View
 from pilosa_tpu.shardwidth import SHARD_WIDTH
 
+
+def _shard_slices(cols: np.ndarray):
+    """Yield (shard, index_array) per touched shard via one stable
+    argsort — per-shard boolean masks are O(n × shards) and dominate
+    imports that span many shards."""
+    shards = cols // np.uint64(SHARD_WIDTH)
+    order = np.argsort(shards, kind="stable")
+    uniq, starts = np.unique(shards[order], return_index=True)
+    bounds = np.append(starts, order.size)
+    for i, shard in enumerate(uniq.tolist()):
+        yield int(shard), order[bounds[i] : bounds[i + 1]]
+
 FIELD_SET = "set"
 FIELD_MUTEX = "mutex"
 FIELD_BOOL = "bool"
@@ -316,42 +328,35 @@ class Field:
                 raise ValueError("bool field rows must be 0 or 1")
             if clear:
                 # clearing needs no single-value enforcement — plain batch
-                shards = cols // np.uint64(SHARD_WIDTH)
-                for shard in np.unique(shards).tolist():
-                    m = shards == shard
+                for shard, sl in _shard_slices(cols):
                     frag = self.create_view_if_not_exists(
                         VIEW_STANDARD
-                    ).create_fragment_if_not_exists(int(shard))
-                    frag.bulk_import(rows[m], cols[m], clear=True)
+                    ).create_fragment_if_not_exists(shard)
+                    frag.bulk_import(rows[sl], cols[sl], clear=True)
                 return
             # last-wins per column, then one vectorized mutex pass per shard
             _, last = np.unique(cols[::-1], return_index=True)
             keep = np.sort(cols.size - 1 - last)
             rows, cols = rows[keep], cols[keep]
-            shards = cols // np.uint64(SHARD_WIDTH)
-            for shard in np.unique(shards).tolist():
-                m = shards == shard
+            for shard, sl in _shard_slices(cols):
                 frag = self.create_view_if_not_exists(
                     VIEW_STANDARD
-                ).create_fragment_if_not_exists(int(shard))
-                frag.mutex_import(rows[m], cols[m])
+                ).create_fragment_if_not_exists(shard)
+                frag.mutex_import(rows[sl], cols[sl])
             return
-        shards = cols // np.uint64(SHARD_WIDTH)
-        for shard in np.unique(shards).tolist():
-            m = shards == shard
+        for shard, sl in _shard_slices(cols):
             if timestamps is None or self.options.field_type != FIELD_TIME:
                 views = self._writable_views(None)
                 for view_name in views:
-                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(int(shard))
-                    frag.bulk_import(rows[m], cols[m], clear=clear)
+                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
+                    frag.bulk_import(rows[sl], cols[sl], clear=clear)
             else:
-                idx = np.flatnonzero(m)
                 by_view: dict[str, list[int]] = {}
-                for i in idx.tolist():
+                for i in sl.tolist():
                     for view_name in self._writable_views(timestamps[i]):
                         by_view.setdefault(view_name, []).append(i)
                 for view_name, ids in by_view.items():
-                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(int(shard))
+                    frag = self.create_view_if_not_exists(view_name).create_fragment_if_not_exists(shard)
                     frag.bulk_import(rows[ids], cols[ids], clear=clear)
 
     def import_values(self, cols: np.ndarray, values: np.ndarray) -> None:

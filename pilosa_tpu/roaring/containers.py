@@ -58,11 +58,16 @@ def run_container(runs: np.ndarray) -> Container:
 
 
 def from_values(values: np.ndarray) -> Container:
-    """Build the best-typed container from sorted-unique uint16 values."""
+    """Array/bitmap container from sorted-unique uint16 values. No run
+    detection here — this is the write hot path (one call per touched
+    container per bulk import); run compaction happens at explicit
+    ``optimize(runs=True)`` time (snapshot/serialize), matching the
+    reference, where writes pick array-vs-bitmap by cardinality only and
+    runs appear via an explicit Optimize pass."""
     values = np.asarray(values, dtype=np.uint16)
     if values.size > ARRAY_MAX:
-        return optimize(bitmap_container(_values_to_words(values)))
-    return optimize(array_container(values))
+        return bitmap_container(_values_to_words(values))
+    return array_container(values)
 
 
 def _values_to_words(values: np.ndarray) -> np.ndarray:
@@ -159,10 +164,13 @@ def container_add(c: Container, v: int) -> tuple[Container, bool]:
     words = as_words(c).copy()
     words[v >> 6] |= np.uint64(1) << np.uint64(v & 63)
     out = bitmap_container(words)
-    # re-optimize on a type transition so run/full-array containers stay
-    # compact under single-bit writes; an already-bitmap container stays
-    # bitmap without paying O(container) re-analysis per add
-    return (optimize(out) if c.type != TYPE_BITMAP else out), True
+    # re-optimize on a type transition; run containers (post-load) keep
+    # full run re-analysis so point writes don't decompact them, while
+    # array→bitmap transitions skip it (write hot path); an already-
+    # bitmap container stays bitmap with no per-add re-analysis
+    if c.type == TYPE_BITMAP:
+        return out, True
+    return optimize(out, runs=c.type == TYPE_RUN), True
 
 
 def container_remove(c: Container, v: int) -> tuple[Container, bool]:
@@ -173,20 +181,31 @@ def container_remove(c: Container, v: int) -> tuple[Container, bool]:
         return array_container(np.delete(c.data, i)), True
     words = as_words(c).copy()
     words[v >> 6] &= ~(np.uint64(1) << np.uint64(v & 63))
-    return optimize(bitmap_container(words)), True
+    return optimize(bitmap_container(words), runs=c.type == TYPE_RUN), True
 
 
-def optimize(c: Container) -> Container:
-    """Convert to the smallest representation (reference: Container.optimize)."""
+def optimize(c: Container, runs: bool = True) -> Container:
+    """Convert to the smallest representation (reference:
+    Container.optimize). ``runs=False`` skips run detection (the write
+    paths use it only to settle array-vs-bitmap after a type-changing
+    mutation); full run compaction is for snapshot/serialize time."""
     n = container_count(c)
     if n == 0:
         return array_container(_EMPTY_U16)
+    if not runs:
+        if c.type != TYPE_RUN:
+            if n <= ARRAY_MAX and c.type != TYPE_ARRAY:
+                return array_container(as_values(c))
+            if n > ARRAY_MAX and c.type != TYPE_BITMAP:
+                return bitmap_container(as_words(c))
+            return c
+        # fall through for run containers: re-analyze fully
     values = as_values(c)
-    runs = _values_to_runs(values)
+    rns = _values_to_runs(values)
     # sizes in bytes: array 2n, bitmap 8192, run 4*len(runs)
-    run_sz, arr_sz = 4 * runs.shape[0], 2 * n
+    run_sz, arr_sz = 4 * rns.shape[0], 2 * n
     if run_sz < min(arr_sz, 8192):
-        return run_container(runs)
+        return run_container(rns)
     if n <= ARRAY_MAX:
         return array_container(values)
     return bitmap_container(as_words(c))
@@ -214,7 +233,7 @@ def _binary_op(a: Container, b: Container, op: str) -> Container:
         w = wa ^ wb
     else:
         w = wa & ~wb
-    return optimize(bitmap_container(w))
+    return optimize(bitmap_container(w), runs=False)
 
 
 def container_and(a: Container, b: Container) -> Container:

@@ -64,7 +64,10 @@ def _payload_bytes(c: ct.Container) -> bytes:
 
 def serialize(bitmap: Bitmap) -> bytes:
     """Snapshot a Bitmap to bytes (no ops log) in the upstream-pilosa
-    layout (roaring.go WriteTo)."""
+    layout (roaring.go WriteTo). Containers are run-compacted here — the
+    write hot paths keep array/bitmap representations (run detection per
+    mutation is pure overhead), and snapshot time is where the reference
+    applies its Optimize pass too."""
     keys = sorted(bitmap._containers)
     buf = io.BytesIO()
     cookie = MAGIC | (STORAGE_VERSION << 16)
@@ -72,6 +75,12 @@ def serialize(bitmap: Bitmap) -> bytes:
     payloads = []
     for key in keys:
         c = bitmap._containers[key]
+        if c.type != ct.TYPE_RUN:  # run containers are already compacted
+            c = ct.optimize(c, runs=True)
+            # write the compacted container back (value-preserving):
+            # run-converted containers skip re-analysis on the next
+            # snapshot and resident memory shrinks
+            bitmap._containers[key] = c
         payloads.append(_payload_bytes(c))
         buf.write(_PILOSA_META.pack(key, c.type, ct.container_count(c) - 1))
     offset = _PILOSA_HEADER.size + len(keys) * (_PILOSA_META.size + 4)

@@ -644,9 +644,7 @@ def test_dead_peer_probes_off_read_path(tmp_path):
         # probe the dead peer, and the class-level patch below must count
         # only read-path probes
         for s in servers[:2]:
-            s.cluster._closed = True
-            if s.cluster._hb_timer is not None:
-                s.cluster._hb_timer.cancel()
+            s.cluster.close()  # stops the heartbeat ticker, keeps serving
         time.sleep(0.1)  # let any in-flight tick drain
 
         probed = []
