@@ -86,6 +86,9 @@ class Fragment:
         # aliases a cache entry
         self.version = 0
         self.uid = next(_FRAGMENT_UIDS)
+        # set by the owning View: bumps its whole-view mutation stamp so
+        # the stack cache can validate a shard list in O(1)
+        self._on_mutate = None
         # (version, row) log so stacked-matrix caches can apply O(dirty
         # rows) device-side deltas instead of re-uploading the stack;
         # bounded — readers asking about versions older than _dirty_floor
@@ -146,7 +149,8 @@ class Fragment:
     def _write_snapshot(self) -> None:
         tmp = self.path + ".snapshotting"
         with open(tmp, "wb") as f:
-            f.write(roaring.serialize(self.bitmap))
+            # in-place compaction is safe here: snapshot() holds _lock
+            f.write(roaring.serialize(self.bitmap, compact_in_place=True))
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self.path)
@@ -361,6 +365,8 @@ class Fragment:
             drop = len(self._dirty_history) // 2
             self._dirty_floor = self._dirty_history[drop - 1][0]
             del self._dirty_history[:drop]
+        if self._on_mutate is not None:
+            self._on_mutate()
 
     def _mark_all_dirty(self) -> None:
         """Bulk/out-of-band rewrite: delta tracking restarts here."""
@@ -370,6 +376,8 @@ class Fragment:
         self.version += 1
         self._dirty_history.clear()
         self._dirty_floor = self.version
+        if self._on_mutate is not None:
+            self._on_mutate()
 
     def dirty_rows_since(self, version: int) -> set[int] | None:
         """Rows dirtied after ``version``, or None when unknowable (the

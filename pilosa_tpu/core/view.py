@@ -7,6 +7,7 @@ one "standard" view; a time field adds one view per calendar bucket; an int
 
 from __future__ import annotations
 
+import itertools
 import os
 import threading
 
@@ -14,6 +15,8 @@ from pilosa_tpu.core.fragment import Fragment
 
 VIEW_STANDARD = "standard"
 VIEW_BSI = "bsi"
+
+_VIEW_STAMPS = itertools.count(1)
 
 
 class View:
@@ -34,6 +37,16 @@ class View:
         self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
         self._create_lock = threading.Lock()
+        # mutation stamp covering EVERY fragment of this view (bumped on
+        # any fragment mutation or creation): lets the query compiler's
+        # stack cache validate a whole shard list in O(1) instead of
+        # reading every fragment's version per query. Stamps come from a
+        # GLOBAL counter so a deleted-and-recreated view can never replay
+        # a stamp an old cache entry carries.
+        self.version = next(_VIEW_STAMPS)
+
+    def _bump_version(self) -> None:
+        self.version = next(_VIEW_STAMPS)
 
     def fragment(self, shard: int) -> Fragment | None:
         return self.fragments.get(shard)
@@ -63,7 +76,9 @@ class View:
                     cache_size=self.cache_size,
                 )
                 frag.open()
+                frag._on_mutate = self._bump_version
                 self.fragments[shard] = frag
+                self._bump_version()
         return frag
 
     def available_shards(self) -> set[int]:

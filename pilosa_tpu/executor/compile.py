@@ -158,17 +158,36 @@ class StackCache:
         STACK_BYTES_BUDGET — callers use hot_slot()/hot_dev() or chunked
         scans instead."""
         view = field.view(view_name)
+        key = (idx.name, field.name, view_name, tuple(shards))
+        # whole-view mutation stamp read BEFORE the per-fragment tokens:
+        # a mutation racing this read advances view.version, so an entry
+        # stamped with the earlier value just re-validates next query
+        view_ver = view.version if view is not None else None
+        with self._lock:
+            cached = self._cache.get(key)
+            if (
+                cached is not None
+                and view_ver is not None
+                and cached[3] == view_ver
+            ):
+                # O(1) fast path — no mutation anywhere in the view since
+                # this entry was stamped, so BOTH O(S) scans (budget
+                # projection + per-fragment tokens; 10k+ calls per leaf
+                # per query at pod scale) are skipped. Over-budget fields
+                # never enter the cache, so a hit implies within-budget.
+                self._cache.move_to_end(key)
+                return cached[1], cached[2]
         r_pad = self._projected_rows(view, shards)
         need = len(shards) * r_pad * WORDS_PER_SHARD * 4
         if need > self.STACK_BYTES_BUDGET:
             raise StackOverBudget(
                 field.name, r_pad, need, self.STACK_BYTES_BUDGET
             )
-        key = (idx.name, field.name, view_name, tuple(shards))
         with self._lock:
-            versions = tuple(self._frag_token(view, s) for s in shards)
             cached = self._cache.get(key)
+            versions = tuple(self._frag_token(view, s) for s in shards)
             if cached is not None and cached[0] == versions:
+                self._cache[key] = (versions, cached[1], cached[2], view_ver)
                 self._cache.move_to_end(key)
                 return cached[1], cached[2]
         # build OUTSIDE the lock: a slow restack/upload must not convoy
@@ -178,7 +197,7 @@ class StackCache:
         # idempotent — rows carry full contents).
         entry = None
         if cached is not None:
-            entry = self._try_delta(cached, view, shards, versions)
+            entry = self._try_delta(cached, view, shards, versions, view_ver)
         if entry is None:
             stacked, max_rows = stack_view_matrices(view, shards)
             if self.mesh_ctx is not None:
@@ -186,7 +205,7 @@ class StackCache:
             else:
                 dev = jnp.asarray(stacked)
             self.full_restacks += 1
-            entry = (versions, dev, max_rows)
+            entry = (versions, dev, max_rows, view_ver)
         with self._lock:
             # last-writer-wins install is self-healing: if a concurrent
             # builder installed a different entry, the next call re-reads
@@ -197,11 +216,11 @@ class StackCache:
                 self._cache.popitem(last=False)
             return entry[1], entry[2]
 
-    def _try_delta(self, cached, view, shards: list[int], versions: tuple):
+    def _try_delta(self, cached, view, shards: list[int], versions: tuple, view_ver):
         """Apply changed fragments' dirty rows to the cached device stack;
         None ⇒ fall back to a full restack (unknown history, fragment
         replaced, row growth past the stack height, or too many rows)."""
-        old_versions, dev, max_rows = cached
+        old_versions, dev, max_rows = cached[0], cached[1], cached[2]
         updates: list[tuple[int, int, np.ndarray]] = []
         for i, s in enumerate(shards):
             old_uid, old_ver = old_versions[i]
@@ -231,7 +250,7 @@ class StackCache:
                 )
                 updates.append((i, r, words))
         if not updates:
-            return (versions, dev, max_rows)
+            return (versions, dev, max_rows, view_ver)
         k_pad = 1 << (len(updates) - 1).bit_length()
         n_shards = len(shards)
         idx_arr = np.full((k_pad, 2), n_shards, dtype=np.int32)  # OOB ⇒ drop
@@ -245,7 +264,7 @@ class StackCache:
             new_dev = jax.device_put(new_dev, dev.sharding)
         self.delta_updates += 1
         self.delta_rows_uploaded += len(updates)
-        return (versions, new_dev, max_rows)
+        return (versions, new_dev, max_rows, view_ver)
 
     @staticmethod
     def _frag_token(view, shard: int) -> tuple:
@@ -273,9 +292,20 @@ class StackCache:
     def _hot_entry(self, idx: Index, field: Field, view_name: str, shards):
         view = field.view(view_name)
         key = ("hot", idx.name, field.name, view_name, tuple(shards))
-        versions = tuple(self._frag_token(view, s) for s in shards)
+        # same O(1) whole-view fast path as matrix(): stamp read before
+        # tokens, so a racing mutation only costs a re-validation
+        view_ver = view.version if view is not None else None
         entry = self._hot.get(key)
         h = self.hot_capacity(len(shards))
+        if (
+            entry is not None
+            and entry["h"] == h
+            and view_ver is not None
+            and entry.get("view_ver") == view_ver
+        ):
+            self._hot.move_to_end(key)
+            return entry, view
+        versions = tuple(self._frag_token(view, s) for s in shards)
         if entry is None or entry["h"] != h:
             from collections import OrderedDict
 
@@ -290,6 +320,7 @@ class StackCache:
                 "dev": dev,
                 "slots": OrderedDict(),
                 "h": h,
+                "view_ver": view_ver,
             }
             self._hot[key] = entry
             self._hot.move_to_end(key)
@@ -324,6 +355,7 @@ class StackCache:
                     [(r, entry["slots"][r]) for r in stale & set(entry["slots"])],
                 )
             entry["versions"] = versions
+        entry["view_ver"] = view_ver
         return entry, view
 
     def _upload_hot_rows(self, entry, view, shards, pairs: list[tuple[int, int]]):

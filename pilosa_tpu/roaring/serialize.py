@@ -62,12 +62,20 @@ def _payload_bytes(c: ct.Container) -> bytes:
     return c.data.tobytes()
 
 
-def serialize(bitmap: Bitmap) -> bytes:
+def serialize(bitmap: Bitmap, compact_in_place: bool = False) -> bytes:
     """Snapshot a Bitmap to bytes (no ops log) in the upstream-pilosa
-    layout (roaring.go WriteTo). Containers are run-compacted here — the
-    write hot paths keep array/bitmap representations (run detection per
-    mutation is pure overhead), and snapshot time is where the reference
-    applies its Optimize pass too."""
+    layout (roaring.go WriteTo). Containers are run-compacted on the way
+    out — the write hot paths keep array/bitmap representations (run
+    detection per mutation is pure overhead), and snapshot time is where
+    the reference applies its Optimize pass too.
+
+    ``compact_in_place=True`` also writes the compacted containers back
+    into the bitmap (amortizes re-analysis across snapshots, shrinks
+    resident memory) — ONLY safe when the caller holds the owning
+    fragment's lock: an unlocked write-back could clobber a concurrent
+    import's container and silently drop its bits. Unlocked callers
+    (e.g. the anti-entropy /fragment/data handler) keep the default
+    read-only behavior."""
     keys = sorted(bitmap._containers)
     buf = io.BytesIO()
     cookie = MAGIC | (STORAGE_VERSION << 16)
@@ -77,10 +85,8 @@ def serialize(bitmap: Bitmap) -> bytes:
         c = bitmap._containers[key]
         if c.type != ct.TYPE_RUN:  # run containers are already compacted
             c = ct.optimize(c, runs=True)
-            # write the compacted container back (value-preserving):
-            # run-converted containers skip re-analysis on the next
-            # snapshot and resident memory shrinks
-            bitmap._containers[key] = c
+            if compact_in_place:
+                bitmap._containers[key] = c
         payloads.append(_payload_bytes(c))
         buf.write(_PILOSA_META.pack(key, c.type, ct.container_count(c) - 1))
     offset = _PILOSA_HEADER.size + len(keys) * (_PILOSA_META.size + 4)
