@@ -344,7 +344,10 @@ class Handler(BaseHTTPRequestHandler):
         self._json(self.stats.expvar())
 
     def h_debug_traces(self) -> None:
-        self._json({"spans": GLOBAL_TRACER.recent()})
+        if self.query_params.get("format", [""])[0] == "chrome":
+            self._json(GLOBAL_TRACER.chrome_trace())
+        else:
+            self._json({"spans": GLOBAL_TRACER.recent()})
 
     # /debug/pprof analogue (reference: net/http/pprof in http/handler.go)
     def h_pprof_profile(self) -> None:

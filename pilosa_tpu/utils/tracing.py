@@ -64,5 +64,27 @@ class Tracer:
         with self._lock:
             return [s.to_json() for s in list(self._spans)[-n:]]
 
+    def chrome_trace(self, n: int = 1000) -> dict:
+        """Spans as Chrome trace-event JSON — loadable in
+        chrome://tracing / Perfetto (the trace-EXPORT story; the
+        reference exports spans to Jaeger, unavailable here)."""
+        with self._lock:
+            spans = list(self._spans)[-n:]
+        return {
+            "traceEvents": [
+                {
+                    "name": s.name,
+                    "ph": "X",
+                    "ts": s.start * 1e6,
+                    "dur": s.duration * 1e6,
+                    "pid": 1,
+                    "tid": 1,
+                    "args": {**s.tags, **({"parent": s.parent} if s.parent else {})},
+                }
+                for s in spans
+            ],
+            "displayTimeUnit": "ms",
+        }
+
 
 GLOBAL_TRACER = Tracer()

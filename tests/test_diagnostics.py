@@ -122,3 +122,14 @@ def test_pprof_heap_endpoint(srv):
     second = call(srv, "GET", "/debug/pprof/heap?top=10")
     assert second["currentBytes"] > 0
     assert len(second["top"]) <= 10
+
+
+def test_traces_chrome_export(srv):
+    """/debug/traces?format=chrome emits Chrome trace-event JSON
+    (loadable in chrome://tracing / Perfetto)."""
+    call(srv, "GET", "/status")  # generate at least one span
+    trace = call(srv, "GET", "/debug/traces?format=chrome")
+    events = trace["traceEvents"]
+    assert events, "no trace events exported"
+    ev = events[-1]
+    assert ev["ph"] == "X" and "name" in ev and "ts" in ev and "dur" in ev
