@@ -16,15 +16,23 @@ from contextlib import contextmanager
 MAX_SPANS = 4096
 
 
+# one wall↔monotonic anchor so exported timestamps share a single
+# monotonic timeline (mixing time.time starts with perf_counter
+# durations lets child slices cross parent boundaries in trace viewers)
+_PERF_EPOCH = time.time() - time.perf_counter()
+
+
 class Span:
-    __slots__ = ("name", "start", "duration", "tags", "parent")
+    __slots__ = ("name", "start", "start_perf", "duration", "tags", "parent", "tid")
 
     def __init__(self, name: str, parent: str | None = None):
         self.name = name
         self.parent = parent
         self.start = time.time()
+        self.start_perf = time.perf_counter()
         self.duration = 0.0
         self.tags: dict = {}
+        self.tid = threading.get_ident()
 
     def set_tag(self, k, v):
         self.tags[k] = v
@@ -75,10 +83,12 @@ class Tracer:
                 {
                     "name": s.name,
                     "ph": "X",
-                    "ts": s.start * 1e6,
+                    # one monotonic timeline anchored to wall time —
+                    # ts and dur must share a clock or nesting breaks
+                    "ts": (s.start_perf + _PERF_EPOCH) * 1e6,
                     "dur": s.duration * 1e6,
                     "pid": 1,
-                    "tid": 1,
+                    "tid": s.tid,
                     "args": {**s.tags, **({"parent": s.parent} if s.parent else {})},
                 }
                 for s in spans
