@@ -9,13 +9,19 @@ reference's per-pair Go loops cannot express.
 
 Run:
 
-    PYTHONPATH=. python examples/chemical_similarity.py --molecules 8192
+    python examples/chemical_similarity.py --molecules 8192
 
 Fingerprints are synthetic 2048-bit Morgan-style vectors; structural
 families share a base pattern so the search has real signal.
 """
 
 from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+# runnable from anywhere: put the repo root on sys.path
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import argparse
 import os

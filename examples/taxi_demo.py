@@ -8,7 +8,7 @@ tutorial's representative queries, printing results and timings.
 
 Run (CPU is fine; scale up on TPU):
 
-    PYTHONPATH=. python examples/taxi_demo.py --rides 200000
+    python examples/taxi_demo.py --rides 200000
 
 Schema (mirrors the reference demo's field layout):
     cab_type          set   (0=yellow 1=green 2=fhv)
@@ -19,6 +19,12 @@ Schema (mirrors the reference demo's field layout):
 """
 
 from __future__ import annotations
+
+import os as _os
+import sys as _sys
+
+# runnable from anywhere: put the repo root on sys.path
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
 import argparse
 import json
@@ -43,7 +49,17 @@ def start_server(data_dir: str):
     from pilosa_tpu.server import Server
     from pilosa_tpu.utils.config import Config
 
-    srv = Server(Config(bind="127.0.0.1:0", data_dir=data_dir, anti_entropy_interval=0))
+    srv = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=data_dir,
+            anti_entropy_interval=0,
+            # bulk loads ship 50k-bit batches; the default 5k
+            # max_writes_per_request cap (HTTP 413) is for serving, not
+            # offline ingest — raise it the way an operator would
+            max_writes_per_request=BATCH,
+        )
+    )
     srv.open()
     return srv
 

@@ -59,11 +59,12 @@ class Tracer:
         s = Span(name, parent=parent.name if parent else None)
         s.tags.update(tags)
         self._local.current = s
-        t0 = time.perf_counter()
         try:
             yield s
         finally:
-            s.duration = time.perf_counter() - t0
+            # same sample as the exported ts — ts and dur must share one
+            # clock origin or child slices cross parent edges in viewers
+            s.duration = time.perf_counter() - s.start_perf
             self._local.current = parent
             with self._lock:
                 self._spans.append(s)
