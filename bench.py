@@ -266,17 +266,26 @@ def main() -> None:
         return
 
     deadline = time.monotonic() + TOTAL_BUDGET_S
+    # halving ladder: an HBM-limit failure at full scale should land on
+    # the LARGEST feasible size, not fall straight to 1/8th
     scales = [FULL_SHARDS]
     while scales[-1] > 256:
-        scales.append(max(256, scales[-1] // 8))
+        scales.append(max(256, scales[-1] // 2))
 
     best = None
     last_err = None
     # full scale first (the north-star number), stepping down only on
-    # failure; two attempts per scale (fresh process each — a wedged
-    # transport often clears on reconnect)
-    for n_shards in scales:
-        for attempt in range(2):
+    # failure; two attempts at full scale (fresh process each — a wedged
+    # transport often clears on reconnect), one per step-down rung. A
+    # PARENT-TIMEOUT failure skips to 1/8th of the failing scale: a
+    # timeout means the whole pipeline is systemically slow, and halving
+    # rungs would each eat a full timeout before the budget finds a
+    # feasible size (fast rc!=0 failures — OOM — walk the dense ladder).
+    i = 0
+    while i < len(scales):
+        n_shards = scales[i]
+        timed_out = False
+        for attempt in range(2 if n_shards == FULL_SHARDS else 1):
             remaining = deadline - time.monotonic()
             if remaining < 60:
                 break
@@ -288,9 +297,15 @@ def main() -> None:
                 best = result
                 break
             last_err = err
+            timed_out = err == "parent timeout"
             _stage({"stage": "attempt_failed", "shards": n_shards, "error": err})
-        if best is not None:
+        if best is not None or deadline - time.monotonic() < 60:
             break
+        if timed_out:
+            target = max(256, n_shards // 8)
+            while i < len(scales) - 1 and scales[i + 1] > target:
+                i += 1
+        i += 1
 
     if best is None and time.monotonic() < deadline - 120:
         # final fallback: a CPU-backend run still proves the stack and

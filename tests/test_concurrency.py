@@ -20,6 +20,13 @@ N_THREADS = 8
 PER_THREAD = 200
 
 
+def call(path, body, base):
+    """POST helper shared by the live-server tests; returns the body."""
+    req = urllib.request.Request(base + path, data=body, method="POST")
+    with urllib.request.urlopen(req) as r:
+        return r.read()
+
+
 def run_threads(fn):
     errs = []
 
@@ -97,21 +104,16 @@ def test_server_concurrent_queries_and_writes(tmp_path):
     srv.open()
     base = f"http://127.0.0.1:{srv.port}"
 
-    def call(path, body):
-        req = urllib.request.Request(base + path, data=body, method="POST")
-        with urllib.request.urlopen(req) as r:
-            r.read()
-
-    call("/index/i", b"{}")
-    call("/index/i/field/f", b"{}")
+    call("/index/i", b"{}", base=base)
+    call("/index/i/field/f", b"{}", base=base)
 
     def work(t):
         for k in range(PER_THREAD):
             col = t * PER_THREAD + k
             if k % 3 == 2:
-                call("/index/i/query", f"Count(Row(f={t}))".encode())
+                call("/index/i/query", f"Count(Row(f={t}))".encode(), base=base)
             else:
-                call("/index/i/query", f"Set({col}, f={t})".encode())
+                call("/index/i/query", f"Set({col}, f={t})".encode(), base=base)
 
     run_threads(work)
     idx = srv.holder.index("i")
@@ -119,4 +121,43 @@ def test_server_concurrent_queries_and_writes(tmp_path):
         want = len([k for k in range(PER_THREAD) if k % 3 != 2])
         frag = idx.field("f").view("standard").fragment(0)
         assert frag.row_count(t) == want
+    srv.close()
+
+
+def test_server_concurrent_bulk_imports_and_queries(tmp_path):
+    """Parallel /import batches interleaved with Count queries: the
+    batched add_many merge and the view-stamp stack-cache fast path must
+    stay exact under concurrency. Thread t is row t's ONLY writer, so
+    its mid-run count is deterministic — each read asserts the exact
+    resident-stack state, not just the final quiescent one."""
+    import json
+
+    srv = Server(
+        Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "bi"),
+               anti_entropy_interval=0)
+    )
+    srv.open()
+    base = f"http://127.0.0.1:{srv.port}"
+
+    call("/index/i", b"{}", base=base)
+    call("/index/i/field/f", b"{}", base=base)
+    per_batch = 500
+
+    def work(t):
+        for k in range(4):
+            lo = (t * 4 + k) * per_batch
+            cols = list(range(lo, lo + per_batch))
+            call(
+                "/index/i/field/f/import",
+                json.dumps({"rowIDs": [t] * per_batch, "columnIDs": cols}).encode(),
+                base=base,
+            )
+            out = call("/index/i/query", f"Count(Row(f={t}))".encode(), base=base)
+            assert json.loads(out)["results"] == [(k + 1) * per_batch], (t, k)
+
+    run_threads(work)
+    idx = srv.holder.index("i")
+    frag = idx.field("f").view("standard").fragment(0)
+    for t in range(N_THREADS):
+        assert frag.row_count(t) == 4 * per_batch, t
     srv.close()
