@@ -297,7 +297,10 @@ def main() -> None:
                 best = result
                 break
             last_err = err
-            timed_out = err == "parent timeout"
+            # sticky per scale: ANY timeout at this rung means the
+            # pipeline is systemically slow, even if a later attempt
+            # fails fast for a different reason
+            timed_out = timed_out or err == "parent timeout"
             _stage({"stage": "attempt_failed", "shards": n_shards, "error": err})
         if best is not None or deadline - time.monotonic() < 60:
             break

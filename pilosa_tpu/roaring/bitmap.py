@@ -18,6 +18,15 @@ _KEY_SHIFT = np.uint64(16)
 _LOW_MASK = np.uint64(0xFFFF)
 
 
+def _tagged_concat(arr_keys: list[int], arr_datas: list[np.ndarray]) -> np.ndarray:
+    """Concatenate per-key sorted uint16 arrays into one GLOBALLY sorted
+    uint64 array of full values (key<<16 | low) — keys must ascend.
+    Shared by add_many/remove_many's batch merge."""
+    lens = np.fromiter((d.size for d in arr_datas), np.int64, len(arr_datas))
+    bases = np.repeat(np.asarray(arr_keys, dtype=np.uint64) << _KEY_SHIFT, lens)
+    return np.concatenate(arr_datas).astype(np.uint64) | bases
+
+
 class Bitmap:
     """A set of uint64 values stored as roaring containers."""
 
@@ -91,16 +100,9 @@ class Bitmap:
             else:
                 heavy.append((i, key, c))
         if arr_datas:
-            # keys ascend and each array is sorted ⇒ the concatenation
-            # tagged with its key base is globally sorted
-            lens = np.fromiter(
-                (d.size for d in arr_datas), np.int64, len(arr_datas)
+            merged = np.unique(
+                np.concatenate([values, _tagged_concat(arr_keys, arr_datas)])
             )
-            bases = np.repeat(
-                np.asarray(arr_keys, dtype=np.uint64) << _KEY_SHIFT, lens
-            )
-            existing_full = np.concatenate(arr_datas).astype(np.uint64) | bases
-            merged = np.unique(np.concatenate([values, existing_full]))
         else:
             merged = values
         if light:
@@ -142,20 +144,63 @@ class Bitmap:
             )
 
     def remove_many(self, values: np.ndarray) -> None:
+        """Vectorised bulk remove — mirror of add_many's batch merge:
+        array-container targets are filtered by ONE searchsorted
+        membership test over their key-tagged concatenation; bitmap/run
+        targets get a vectorized word-ANDNOT each."""
         if values.size == 0:
             return
         values = np.unique(values.astype(np.uint64))
         keys = (values >> _KEY_SHIFT).astype(np.int64)
-        lows = (values & _LOW_MASK).astype(np.uint16)
         uniq_keys, starts = np.unique(keys, return_index=True)
         bounds = np.append(starts, keys.size)
-        for i, key in enumerate(uniq_keys):
-            key = int(key)
-            existing = self._containers.get(key)
-            if existing is None:
+        get = self._containers.get
+        arr_datas: list[np.ndarray] = []
+        arr_keys: list[int] = []
+        heavy: list[tuple[int, int, ct.Container]] = []
+        for i, key in enumerate(uniq_keys.tolist()):
+            c = get(key)
+            if c is None:
                 continue
+            if c.type == ct.TYPE_ARRAY:
+                arr_datas.append(c.data)
+                arr_keys.append(key)
+            else:
+                heavy.append((i, key, c))
+        if arr_datas:
+            existing_full = _tagged_concat(arr_keys, arr_datas)
+            # sorted-membership test: values is sorted unique
+            pos = np.searchsorted(values, existing_full)
+            posc = np.minimum(pos, values.size - 1)
+            keep = values[posc] != existing_full
+            kept = existing_full[keep]
+            klows = (kept & _LOW_MASK).astype(np.uint16)
+            kbounds = np.searchsorted(
+                kept >> _KEY_SHIFT, np.asarray(arr_keys + [1 << 48], dtype=np.uint64)
+            )
+            containers = self._containers
+            for j, key in enumerate(arr_keys):
+                chunk = klows[kbounds[j] : kbounds[j + 1]]
+                if chunk.size == 0:
+                    del containers[key]
+                else:
+                    containers[key] = ct.Container(ct.TYPE_ARRAY, chunk)
+        lows = (values & _LOW_MASK).astype(np.int64)
+        for i, key, c in heavy:
             chunk = lows[bounds[i] : bounds[i + 1]]
-            nc = ct.container_andnot(existing, ct.from_values(chunk))
+            words = (
+                c.data.copy() if c.type == ct.TYPE_BITMAP else ct.as_words(c)
+            )
+            # ufunc.at, not fancy-index assignment: several cleared bits
+            # can share one word and must all accumulate
+            np.bitwise_and.at(
+                words,
+                chunk >> 6,
+                ~(np.uint64(1) << (chunk & 63).astype(np.uint64)),
+            )
+            nc = ct.optimize(
+                ct.bitmap_container(words), runs=c.type == ct.TYPE_RUN
+            )
             if ct.container_count(nc) == 0:
                 del self._containers[key]
             else:

@@ -252,3 +252,30 @@ def test_pilosa_format_through_import_roaring():
     frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
     frag.import_roaring(payload)
     assert frag.contains(0, 5) and frag.contains(0, 9) and frag.contains(1, 3)
+
+
+def test_bulk_mutation_fuzz_against_set_oracle():
+    """add_many/remove_many batch paths vs a python-set oracle across
+    mixed container types (serialize round trips force array/bitmap/run
+    transitions mid-sequence)."""
+    rng = np.random.default_rng(99)
+    for trial in range(15):
+        b = roaring.Bitmap()
+        oracle: set[int] = set()
+        for _ in range(6):
+            n = int(rng.integers(1, 30000))
+            span = int(rng.choice([1 << 16, 1 << 20, 1 << 24]))
+            vals = rng.integers(0, span, n).astype(np.uint64)
+            if rng.random() < 0.25:  # dense run-like block
+                start = int(rng.integers(0, span))
+                vals = np.arange(start, start + n, dtype=np.uint64)
+            if rng.random() < 0.5:
+                b.add_many(vals)
+                oracle |= set(vals.tolist())
+            else:
+                b.remove_many(vals)
+                oracle -= set(vals.tolist())
+            if rng.random() < 0.2:
+                b, _ = roaring.deserialize(roaring.serialize(b))
+        assert set(b.values().tolist()) == oracle, trial
+        assert b.count() == len(oracle)
