@@ -89,6 +89,8 @@ class Fragment:
         # set by the owning View: bumps its whole-view mutation stamp so
         # the stack cache can validate a shard list in O(1)
         self._on_mutate = None
+        # (version, ids) memo for row_ids()
+        self._row_ids_cache: tuple[int, list[int]] | None = None
         # (version, row) log so stacked-matrix caches can apply O(dirty
         # rows) device-side deltas instead of re-uploading the stack;
         # bounded — readers asking about versions older than _dirty_floor
@@ -179,12 +181,20 @@ class Fragment:
         return sorted(candidates)
 
     def row_ids(self) -> list[int]:
-        """Row IDs with ≥1 bit set (reference: fragment.rows)."""
-        return [
-            r
-            for r in self._candidate_rows()
-            if self.bitmap.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
-        ]
+        """Row IDs with ≥1 bit set (reference: fragment.rows). Memoized
+        per mutation version — Rows/GroupBy/TopN consult this on every
+        query and the candidate scan + per-row range_count is O(rows)."""
+        with self._lock:
+            cached = self._row_ids_cache
+            if cached is not None and cached[0] == self.version:
+                return cached[1]
+            ids = [
+                r
+                for r in self._candidate_rows()
+                if self.bitmap.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
+            ]
+            self._row_ids_cache = (self.version, ids)
+            return ids
 
     def row_columns(self, row: int) -> np.ndarray:
         """Absolute column IDs set in a row, ascending (uint64)."""
