@@ -89,8 +89,9 @@ class Fragment:
         # set by the owning View: bumps its whole-view mutation stamp so
         # the stack cache can validate a shard list in O(1)
         self._on_mutate = None
-        # (version, ids) memo for row_ids()
-        self._row_ids_cache: tuple[int, list[int]] | None = None
+        # (version, ids) memo for row_ids(); ids stored as a tuple so a
+        # caller mutating its result can't corrupt the memo
+        self._row_ids_cache: tuple[int, tuple[int, ...]] | None = None
         # (version, row) log so stacked-matrix caches can apply O(dirty
         # rows) device-side deltas instead of re-uploading the stack;
         # bounded — readers asking about versions older than _dirty_floor
@@ -187,13 +188,13 @@ class Fragment:
         with self._lock:
             cached = self._row_ids_cache
             if cached is not None and cached[0] == self.version:
-                return cached[1]
+                return list(cached[1])
             ids = [
                 r
                 for r in self._candidate_rows()
                 if self.bitmap.range_count(r * SHARD_WIDTH, (r + 1) * SHARD_WIDTH)
             ]
-            self._row_ids_cache = (self.version, ids)
+            self._row_ids_cache = (self.version, tuple(ids))
             return ids
 
     def row_columns(self, row: int) -> np.ndarray:
