@@ -45,6 +45,12 @@ from pilosa_tpu.roaring.bitmap import Bitmap
 MAGIC = 12348
 STORAGE_VERSION = 0  # upstream pilosa storageVersion (written format)
 VERSION = 1  # this framework's round-1 layout (read-compat only)
+# the OFFICIAL 32-bit roaring interchange format (RoaringFormatSpec);
+# upstream pilosa's UnmarshalBinary accepts it on import, so import-
+# roaring payloads produced by stock CRoaring/RoaringBitmap clients work
+OFFICIAL_COOKIE = 12347  # run containers present (packed count, run bitset)
+OFFICIAL_COOKIE_NO_RUNS = 12346  # no runs; separate uint32 count, offsets
+_OFFICIAL_NO_OFFSET_THRESHOLD = 4
 OP_MAGIC = 0xF1
 OP_ADD = 1
 OP_REMOVE = 2
@@ -108,6 +114,8 @@ def deserialize(data: bytes) -> tuple[Bitmap, int]:
     """
     try:
         magic, version, _n = _HEADER.unpack_from(data, 0)
+        if magic in (OFFICIAL_COOKIE, OFFICIAL_COOKIE_NO_RUNS):
+            return _deserialize_official(data)
         if magic != MAGIC:
             raise ValueError(f"bad roaring magic {magic}")
         if version == STORAGE_VERSION:
@@ -115,7 +123,7 @@ def deserialize(data: bytes) -> tuple[Bitmap, int]:
         if version == VERSION:
             return _deserialize_legacy(data)
         raise ValueError(f"unsupported roaring version {version}")
-    except struct.error as e:
+    except (struct.error, IndexError) as e:
         raise ValueError(f"truncated roaring snapshot: {e}") from e
 
 
@@ -152,6 +160,60 @@ def _deserialize_pilosa(data: bytes) -> tuple[Bitmap, int]:
         b._containers[key] = ct.Container(c.type, c.data.copy())
         end = max(end, off + size)
     return b, end
+
+
+def _deserialize_official(data: bytes) -> tuple[Bitmap, int]:
+    """Official 32-bit roaring layout (RoaringFormatSpec). Keys are
+    uint16 (the 32-bit value space's high half), mapping directly onto
+    this Bitmap's low 2^32 positions. Run intervals are (start,
+    length-1) pairs — converted to the internal (start, last) form."""
+    (cookie16,) = struct.unpack_from("<H", data, 0)
+    pos = 0
+    if cookie16 == OFFICIAL_COOKIE:
+        (packed,) = struct.unpack_from("<I", data, 0)
+        n = (packed >> 16) + 1
+        pos = 4
+        bitset_len = (n + 7) // 8
+        run_bitset = data[pos : pos + bitset_len]
+        pos += bitset_len
+        has_offsets = n >= _OFFICIAL_NO_OFFSET_THRESHOLD
+    else:  # OFFICIAL_COOKIE_NO_RUNS
+        (n,) = struct.unpack_from("<I", data, 4)
+        pos = 8
+        run_bitset = b""
+        has_offsets = True
+
+    def _is_run(i: int) -> bool:
+        return bool(run_bitset and (run_bitset[i >> 3] >> (i & 7)) & 1)
+
+    metas = []
+    for i in range(n):
+        key, card_m1 = struct.unpack_from("<HH", data, pos + 4 * i)
+        metas.append((key, card_m1 + 1))
+    pos += 4 * n
+    if has_offsets:
+        pos += 4 * n  # offsets are redundant for sequential parsing
+    b = Bitmap()
+    for i, (key, card) in enumerate(metas):
+        if _is_run(i):
+            (n_runs,) = struct.unpack_from("<H", data, pos)
+            pos += 2
+            pairs = np.frombuffer(data, np.uint16, n_runs * 2, pos).reshape(-1, 2)
+            pos += n_runs * 4
+            runs = np.stack(
+                [pairs[:, 0], pairs[:, 0] + pairs[:, 1]], axis=1
+            ).astype(np.uint16)
+            c = ct.run_container(runs)
+        elif card > ct.ARRAY_MAX:
+            c = ct.bitmap_container(
+                np.frombuffer(data, np.uint64, ct.BITMAP_N, pos)
+            )
+            pos += ct.BITMAP_N * 8
+        else:
+            c = ct.array_container(np.frombuffer(data, np.uint16, card, pos))
+            pos += card * 2
+        b._containers[key] = ct.Container(c.type, c.data.copy())
+    return b, pos
 
 
 def _deserialize_legacy(data: bytes) -> tuple[Bitmap, int]:

@@ -279,3 +279,69 @@ def test_bulk_mutation_fuzz_against_set_oracle():
                 b, _ = roaring.deserialize(roaring.serialize(b))
         assert set(b.values().tolist()) == oracle, trial
         assert b.count() == len(oracle)
+
+
+def test_official_roaring_format_reads():
+    """Payloads in the OFFICIAL 32-bit roaring interchange layout
+    (RoaringFormatSpec cookies 12346/12347) parse — stock CRoaring /
+    RoaringBitmap clients' import-roaring bodies work, as upstream
+    pilosa's UnmarshalBinary allows."""
+    import struct
+
+    # cookie 12347 (SERIAL_COOKIE: runs present, count-1 packed in the
+    # high half), 3 containers (array, run, bitmap), n<4 ⇒ no offsets
+    n = 3
+    buf = struct.pack("<I", 12347 | (n - 1) << 16)
+    buf += bytes([0b010])  # container 1 is a run
+    arr_vals = np.array([1, 5, 9, 100], dtype=np.uint16)
+    run_start, run_len = 100, 50  # values 100..149
+    bm_vals = np.arange(0, 65536, 13, dtype=np.uint16)  # card 5042 > 4096
+    buf += struct.pack("<HH", 0, arr_vals.size - 1)
+    buf += struct.pack("<HH", 1, run_len - 1)
+    buf += struct.pack("<HH", 2, bm_vals.size - 1)
+    buf += arr_vals.tobytes()
+    buf += struct.pack("<HHH", 1, run_start, run_len - 1)  # n_runs, start, len-1
+    words = np.zeros(1024, dtype=np.uint64)
+    np.bitwise_or.at(
+        words,
+        bm_vals.astype(np.uint64) >> np.uint64(6),
+        np.uint64(1) << (bm_vals.astype(np.uint64) & np.uint64(63)),
+    )
+    buf += words.tobytes()
+
+    got, consumed = roaring.deserialize(buf)
+    assert consumed == len(buf)
+    expect = set(arr_vals.tolist())
+    expect |= {(1 << 16) + v for v in range(run_start, run_start + run_len)}
+    expect |= {(2 << 16) + int(v) for v in bm_vals.tolist()}
+    assert set(got.values().tolist()) == expect
+
+    # cookie 12346 (SERIAL_COOKIE_NO_RUNCONTAINER): separate uint32
+    # count, offsets always present
+    vals = np.array([7, 8, 9], dtype=np.uint16)
+    buf2 = struct.pack("<II", 12346, 1)
+    buf2 += struct.pack("<HH", 4, vals.size - 1)
+    buf2 += struct.pack("<I", 8 + 4 + 4)  # offset of data from start
+    buf2 += vals.tobytes()
+    got2, consumed2 = roaring.deserialize(buf2)
+    assert consumed2 == len(buf2)
+    assert set(got2.values().tolist()) == {(4 << 16) + v for v in (7, 8, 9)}
+
+
+def test_official_format_through_import_roaring():
+    """An official-format payload unions into a fragment via the same
+    import-roaring path as pilosa-layout payloads."""
+    import struct
+
+    from pilosa_tpu.core import Holder
+
+    h = Holder(None)
+    f = h.create_index("of").create_field("f")
+    vals = np.array([3, 4, 50], dtype=np.uint16)
+    payload = struct.pack("<II", 12346, 1)
+    payload += struct.pack("<HH", 0, vals.size - 1)
+    payload += struct.pack("<I", 16)
+    payload += vals.tobytes()
+    frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
+    frag.import_roaring(payload)
+    assert frag.contains(0, 3) and frag.contains(0, 50) and not frag.contains(0, 5)
