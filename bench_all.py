@@ -256,6 +256,28 @@ def config5_tanimoto():
     )
 
 
+def config6_ingest():
+    """Bulk-import throughput (host-side; the reference's bulkImport
+    analogue): fresh import and merge-into-existing, Mbits/s."""
+    from pilosa_tpu.core import Holder
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(6)
+    n = int(os.environ.get("PILOSA_BENCH_INGEST_BITS", "5000000"))
+    rows = rng.integers(0, 1000, n).astype(np.uint64)
+    cols = rng.integers(0, 4 * SHARD_WIDTH, n).astype(np.uint64)
+    h = Holder(None)
+    f = h.create_index("ing").create_field("f")
+    t0 = time.perf_counter()
+    f.import_bulk(rows, cols)
+    fresh = n / (time.perf_counter() - t0) / 1e6
+    t0 = time.perf_counter()
+    f.import_bulk(rows, cols)  # idempotent merge over existing containers
+    merge = n / (time.perf_counter() - t0) / 1e6
+    line("ingest_fresh_mbits_per_s", fresh, "Mbit/s", 1.0)
+    line("ingest_merge_mbits_per_s", merge, "Mbit/s", 1.0)
+
+
 def main():
     for cfg in (
         config1_pql_single_shard,
@@ -263,6 +285,7 @@ def main():
         config3_topn_groupby,
         config4_bsi_sum_range,
         config5_tanimoto,
+        config6_ingest,
     ):
         cfg()
 
