@@ -3,11 +3,14 @@
 Reference: roaring/roaring.go (WriteTo/UnmarshalBinary with the
 pilosa-specific cookie, and the appended ops log: op / OpWriter).
 
-Two snapshot layouts are readable; the upstream-pilosa layout is the one
-written (VERDICT r2 item 10 — wire interop with stock pilosa clients'
-``import-roaring`` payloads and fragment files). Both start with the
-uint16 magic 12348; the next uint16 distinguishes them (0 = upstream
-storageVersion, 1 = this framework's round-1 layout).
+Three snapshot layouts are readable; the upstream-pilosa layout is the
+one written (VERDICT r2 item 10 — wire interop with stock pilosa
+clients' ``import-roaring`` payloads and fragment files). The pilosa
+and legacy layouts start with the uint16 magic 12348 (next uint16: 0 =
+upstream storageVersion, 1 = this framework's round-1 layout); the
+OFFICIAL 32-bit interchange layout (RoaringFormatSpec cookies
+12346/12347, what stock CRoaring/RoaringBitmap clients emit) is also
+accepted on read.
 
 Upstream layout (little-endian; roaring.go WriteTo — reconstructed from
 upstream v1.x knowledge, unverified against the fork because the
@@ -107,10 +110,11 @@ def serialize(bitmap: Bitmap, compact_in_place: bool = False) -> bytes:
 def deserialize(data: bytes) -> tuple[Bitmap, int]:
     """Parse a snapshot; returns (bitmap, bytes consumed by the snapshot).
 
-    Dispatches on the version word after the shared magic: upstream
-    pilosa layout (storageVersion 0) or this framework's legacy layout
-    (version 1). Any bytes after the snapshot are ops-log records; use
-    ``replay_ops`` on the remainder.
+    Dispatches on the leading cookie: official RoaringFormatSpec
+    layouts (12346/12347), then the shared magic 12348's version word —
+    upstream pilosa layout (storageVersion 0) or this framework's
+    legacy layout (version 1). Any bytes after the snapshot are ops-log
+    records; use ``replay_ops`` on the remainder.
     """
     try:
         magic, version, _n = _HEADER.unpack_from(data, 0)
@@ -200,9 +204,13 @@ def _deserialize_official(data: bytes) -> tuple[Bitmap, int]:
             pos += 2
             pairs = np.frombuffer(data, np.uint16, n_runs * 2, pos).reshape(-1, 2)
             pos += n_runs * 4
-            runs = np.stack(
-                [pairs[:, 0], pairs[:, 0] + pairs[:, 1]], axis=1
-            ).astype(np.uint16)
+            # widen before adding: a corrupt pair must raise, not wrap
+            last = pairs[:, 0].astype(np.int64) + pairs[:, 1].astype(np.int64)
+            if (last > 0xFFFF).any():
+                raise ValueError("official roaring run exceeds container bounds")
+            runs = np.stack([pairs[:, 0].astype(np.int64), last], axis=1).astype(
+                np.uint16
+            )
             c = ct.run_container(runs)
         elif card > ct.ARRAY_MAX:
             c = ct.bitmap_container(
