@@ -271,6 +271,19 @@ class StackCache:
         frag = view.fragment(shard) if view else None
         return (-1, -1) if frag is None else (frag.uid, frag.version)
 
+    def stats_snapshot(self) -> dict:
+        """Consistent counter view for /debug/vars (owns the field names
+        so transport code never reads cache internals)."""
+        with self._lock:
+            return {
+                "fullRestacks": self.full_restacks,
+                "deltaUpdates": self.delta_updates,
+                "deltaRowsUploaded": self.delta_rows_uploaded,
+                "hotRowUploads": self.hot_row_uploads,
+                "entries": len(self._cache),
+                "hotEntries": len(self._hot),
+            }
+
     def invalidate(self) -> None:
         with self._lock:
             self._cache.clear()

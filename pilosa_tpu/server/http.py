@@ -341,7 +341,11 @@ class Handler(BaseHTTPRequestHandler):
         self._text(self.stats.prometheus(), content_type="text/plain; version=0.0.4")
 
     def h_debug_vars(self) -> None:
-        self._json(self.stats.expvar())
+        out = self.stats.expvar()
+        # device-cache effectiveness counters (tests assert the write
+        # path stays incremental; operators read them here)
+        out["stackCache"] = self.api.executor.compiler.stacks.stats_snapshot()
+        self._json(out)
 
     def h_debug_traces(self) -> None:
         if self.query_params.get("format", [""])[0] == "chrome":

@@ -133,3 +133,14 @@ def test_traces_chrome_export(srv):
     assert events, "no trace events exported"
     ev = events[-1]
     assert ev["ph"] == "X" and "name" in ev and "ts" in ev and "dur" in ev
+
+
+def test_debug_vars_exposes_stack_cache_counters(srv):
+    srv.api.create_index("sv", {})
+    srv.api.create_field("sv", "f", {})
+    call(srv, "POST", "/index/sv/query", b"Set(1, f=1)")
+    call(srv, "POST", "/index/sv/query", b"Count(Row(f=1))")
+    v = call(srv, "GET", "/debug/vars")
+    sc = v["stackCache"]
+    assert sc["fullRestacks"] >= 1
+    assert set(sc) >= {"deltaUpdates", "deltaRowsUploaded", "hotRowUploads", "entries"}
