@@ -204,7 +204,8 @@ class StackCache:
                 dev = self.mesh_ctx.place_stack(stacked)
             else:
                 dev = jnp.asarray(stacked)
-            self.full_restacks += 1
+            with self._lock:
+                self.full_restacks += 1
             entry = (versions, dev, max_rows, view_ver)
         with self._lock:
             # last-writer-wins install is self-healing: if a concurrent
@@ -262,8 +263,9 @@ class StackCache:
         if new_dev.sharding != dev.sharding:
             # the scatter must not silently demote the stack's SPMD layout
             new_dev = jax.device_put(new_dev, dev.sharding)
-        self.delta_updates += 1
-        self.delta_rows_uploaded += len(updates)
+        with self._lock:
+            self.delta_updates += 1
+            self.delta_rows_uploaded += len(updates)
         return (versions, new_dev, max_rows, view_ver)
 
     @staticmethod
@@ -272,8 +274,9 @@ class StackCache:
         return (-1, -1) if frag is None else (frag.uid, frag.version)
 
     def stats_snapshot(self) -> dict:
-        """Consistent counter view for /debug/vars (owns the field names
-        so transport code never reads cache internals)."""
+        """Counter view for /debug/vars (owns the field names so
+        transport code never reads cache internals); increments happen
+        under the same lock, so no update is lost."""
         with self._lock:
             return {
                 "fullRestacks": self.full_restacks,
@@ -391,6 +394,8 @@ class StackCache:
         if new_dev.sharding != entry["dev"].sharding:
             new_dev = jax.device_put(new_dev, entry["dev"].sharding)
         entry["dev"] = new_dev
+        # no lock acquisition: every caller (hot_batch → _hot_entry →
+        # here) already holds self._lock, which is non-reentrant
         self.hot_row_uploads += len(pairs)
 
     def hot_batch(

@@ -23,7 +23,11 @@ class Server:
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
         self.holder = Holder(os.path.expanduser(self.config.data_dir))
-        self.stats = StatsClient()
+        from pilosa_tpu.utils.stats import make_stats
+
+        self.stats = make_stats(
+            self.config.metric_service, self.config.statsd_host
+        )
         self.cluster = None
         # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
         # backend (seconds, or worse on a wedged transport) — that must
@@ -138,4 +142,5 @@ class Server:
         if self.http is not None:
             self.http.shutdown()
             self.http.server_close()
+        self.stats.close()
         self.holder.close()

@@ -44,7 +44,8 @@ class Config:
     num_processes: int = 0  # 0 = let jax.distributed infer
     process_id: int = -1  # -1 = let jax.distributed infer
     # metrics
-    metric_service: str = "prometheus"
+    metric_service: str = "prometheus"  # prometheus | statsd | none
+    statsd_host: str = ""  # host:port for metric_service = "statsd"
 
     @property
     def host(self) -> str:

@@ -39,6 +39,9 @@ class StatsClient:
             entry[0] += 1
             entry[1] += seconds
 
+    def close(self) -> None:
+        """Release emission resources (no-op for registry-only clients)."""
+
     def timer(self, name: str, tags: dict | None = None):
         """Context manager recording elapsed seconds."""
         client = self
@@ -92,6 +95,83 @@ class StatsClient:
                 lines.append(f"{base}_seconds_count{labels(k)} {c}")
                 lines.append(f"{base}_seconds_sum{labels(k)} {s}")
         return "\n".join(lines) + "\n"
+
+
+class StatsdStats(StatsClient):
+    """StatsClient that ALSO emits each update as a statsd datagram
+    (reference: stats/statsd adapter). Datagram format is classic statsd
+    with dogstatsd-style ``|#tag:value`` tags; UDP, fire-and-forget —
+    emission failures never affect the serving path. The in-process
+    registry still accumulates, so /metrics and /debug/vars keep
+    working alongside."""
+
+    def __init__(self, host: str, port: int, prefix: str = "pilosa_tpu"):
+        super().__init__(prefix=prefix)
+        import socket
+
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        # resolve ONCE here — sendto with a hostname would do a
+        # synchronous DNS lookup per metric, in the request path
+        self._sock.connect((host, port))
+
+    @staticmethod
+    def _num(value: float) -> str:
+        # plain decimal only: %g's scientific notation for >=1e6 is
+        # dropped by strict statsd parsers
+        if float(value).is_integer():
+            return str(int(value))
+        return f"{value:.6f}".rstrip("0").rstrip(".")
+
+    def _emit(self, name: str, value: str, kind: str, tags: dict | None) -> None:
+        msg = f"{self.prefix}.{name}:{value}|{kind}"
+        if tags:
+            msg += "|#" + ",".join(f"{t}:{v}" for t, v in sorted(tags.items()))
+        try:
+            self._sock.send(msg.encode())
+        except OSError:
+            pass
+
+    def count(self, name: str, value: float = 1, tags: dict | None = None) -> None:
+        super().count(name, value, tags)
+        self._emit(name, self._num(value), "c", tags)
+
+    def gauge(self, name: str, value: float, tags: dict | None = None) -> None:
+        super().gauge(name, value, tags)
+        self._emit(name, self._num(value), "g", tags)
+
+    def timing(self, name: str, seconds: float, tags: dict | None = None) -> None:
+        super().timing(name, seconds, tags)
+        self._emit(name, self._num(seconds * 1e3), "ms", tags)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def make_stats(service: str, statsd_host: str = "") -> StatsClient:
+    """Factory from config: ``metric_service`` = prometheus (registry,
+    read by /metrics and /debug/vars), statsd (registry + UDP emission
+    to ``statsd_host`` as host:port), or none. Misconfiguration raises —
+    a silently inert metrics setup is only discovered when dashboards
+    stay empty."""
+    if service == "statsd":
+        if not statsd_host:
+            raise ValueError(
+                "metric_service = 'statsd' requires statsd_host (host:port)"
+            )
+        host, sep, port = statsd_host.rpartition(":")
+        if not sep:
+            host, port = statsd_host, "8125"
+        try:
+            return StatsdStats(host or "127.0.0.1", int(port))
+        except (ValueError, OSError) as e:
+            raise ValueError(f"bad statsd_host {statsd_host!r}: {e}") from e
+    if service in ("", "none", "nop"):
+        return NopStats()
+    if service != "prometheus":
+        raise ValueError(
+            f"unknown metric_service {service!r}; use prometheus, statsd, or none"
+        )
+    return StatsClient()
 
 
 class NopStats(StatsClient):

@@ -144,3 +144,34 @@ def test_debug_vars_exposes_stack_cache_counters(srv):
     sc = v["stackCache"]
     assert sc["fullRestacks"] >= 1
     assert set(sc) >= {"deltaUpdates", "deltaRowsUploaded", "hotRowUploads", "entries"}
+
+
+def test_statsd_emission(tmp_path):
+    """metric_service=statsd emits UDP datagrams (classic statsd with
+    dogstatsd tags) while /metrics keeps serving from the registry."""
+    import socket
+
+    sink = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sink.bind(("127.0.0.1", 0))
+    sink.settimeout(5)
+    port = sink.getsockname()[1]
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "sd"),
+            anti_entropy_interval=0,
+            metric_service="statsd",
+            statsd_host=f"127.0.0.1:{port}",
+        )
+    )
+    s.open()
+    try:
+        call(s, "GET", "/status")
+        msg = sink.recv(4096).decode()
+        assert msg.startswith("pilosa_tpu.http_requests:1|c"), msg
+        # the registry still feeds /metrics
+        text = call(s, "GET", "/metrics", raw=True).decode()
+        assert "pilosa_tpu_http_requests" in text
+    finally:
+        sink.close()
+        s.close()
