@@ -768,7 +768,9 @@ class QueryCompiler:
             key, lambda: jax.jit(lambda arrays, scalars: run(arrays, scalars))
         )
         arrays = planner.materialize()
-        return prog(arrays, jnp.asarray(planner.scalar_values(), jnp.int32))
+        # numpy, not jnp: a jnp.asarray here is a traced op dispatch per
+        # query (~0.2 ms on CPU); jit converts numpy args at call time
+        return prog(arrays, np.asarray(planner.scalar_values(), dtype=np.int32))
 
     def bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
         return np.asarray(self.bitmap_device(idx, call, shards))
@@ -789,7 +791,9 @@ class QueryCompiler:
 
         prog = self.program(key, build)
         arrays = planner.materialize()
-        return prog(arrays, jnp.asarray(planner.scalar_values(), jnp.int32))
+        # numpy, not jnp: a jnp.asarray here is a traced op dispatch per
+        # query (~0.2 ms on CPU); jit converts numpy args at call time
+        return prog(arrays, np.asarray(planner.scalar_values(), dtype=np.int32))
 
     def count(self, idx: Index, call: Call, shards: list[int]) -> int:
         return int(self.count_async(idx, call, shards))
