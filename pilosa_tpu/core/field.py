@@ -17,7 +17,7 @@ from __future__ import annotations
 import json
 import os
 import threading
-from dataclasses import asdict, dataclass, field as dc_field
+from dataclasses import asdict, dataclass
 from datetime import datetime
 
 import numpy as np

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import os
 import threading
-from datetime import datetime
 from typing import Any, Callable
 
 import numpy as np

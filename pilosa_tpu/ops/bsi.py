@@ -22,7 +22,7 @@ from __future__ import annotations
 import jax.numpy as jnp
 import numpy as np
 
-from pilosa_tpu.ops.bitwise import matrix_filter_counts, popcount, popcount_rows
+from pilosa_tpu.ops.bitwise import matrix_filter_counts, popcount
 
 EXISTS_ROW = 0
 SIGN_ROW = 1

@@ -18,7 +18,6 @@ import numpy as np
 
 from pilosa_tpu import __version__
 from pilosa_tpu.core import (
-    FIELD_INT,
     VIEW_STANDARD,
     Field,
     FieldOptions,

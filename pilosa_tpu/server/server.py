@@ -15,7 +15,6 @@ import threading
 from pilosa_tpu.core import Holder
 from pilosa_tpu.server.api import API
 from pilosa_tpu.server.http import HTTPServer
-from pilosa_tpu.utils import StatsClient
 from pilosa_tpu.utils.config import Config
 
 
