@@ -31,12 +31,33 @@ def _apply_jax_platform_env() -> None:
         jax.config.update("jax_platforms", plat)
 
 
+def _base_uri(host: str) -> str:
+    """--host accepts `host:port` (http) or a scheme-qualified URI
+    (`https://host:port` for TLS servers)."""
+    if host.startswith(("http://", "https://")):
+        return host.rstrip("/")
+    return f"http://{host}"
+
+
+_SSL_CTX = None  # set by subcommands when --tls-skip-verify is passed
+
+
 def _http(method: str, url: str, body: bytes | None = None, ctype: str = "application/json"):
     req = urllib.request.Request(url, data=body, method=method)
     if body is not None:
         req.add_header("Content-Type", ctype)
-    with urllib.request.urlopen(req) as resp:
+    with urllib.request.urlopen(req, context=_SSL_CTX) as resp:
         return json.loads(resp.read() or b"{}")
+
+
+def _apply_skip_verify(args) -> None:
+    global _SSL_CTX
+    if getattr(args, "tls_skip_verify", False):
+        import ssl
+
+        _SSL_CTX = ssl._create_unverified_context()
+    else:
+        _SSL_CTX = None  # never inherit skip-verify from a prior invocation
 
 
 def cmd_server(args) -> int:
@@ -51,6 +72,9 @@ def cmd_server(args) -> int:
             "coordinator": args.coordinator or None,
             "seeds": args.seeds.split(",") if args.seeds else None,
             "replica_n": args.replica_n,
+            "tls_certificate": args.tls_certificate,
+            "tls_key": args.tls_key,
+            "tls_skip_verify": args.tls_skip_verify or None,
         },
     )
     srv = Server(cfg)
@@ -86,9 +110,11 @@ def cmd_import(args) -> int:
                 cols.append(int(parts[1]))
                 if len(parts) > 2:
                     timestamps.append(parts[2])
-    base = f"http://{args.host}/index/{args.index}/field/{args.field}"
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+    base = f"{root}/index/{args.index}/field/{args.field}"
     if args.create:
-        _http("POST", f"http://{args.host}/index/{args.index}", b"{}")
+        _http("POST", f"{root}/index/{args.index}", b"{}")
         opts = {"options": {"type": "int"}} if args.values else {}
         _http("POST", base, json.dumps(opts).encode())
     batch = args.batch_size
@@ -107,9 +133,10 @@ def cmd_import(args) -> int:
 
 
 def cmd_export(args) -> int:
-    url = f"http://{args.host}/export?index={args.index}&field={args.field}"
+    _apply_skip_verify(args)
+    url = f"{_base_uri(args.host)}/export?index={args.index}&field={args.field}"
     req = urllib.request.Request(url)
-    with urllib.request.urlopen(req) as resp:
+    with urllib.request.urlopen(req, context=_SSL_CTX) as resp:
         sys.stdout.write(resp.read().decode())
     return 0
 
@@ -179,11 +206,21 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--coordinator", action="store_true")
     s.add_argument("--seeds", default=None, help="comma-separated peer URIs")
     s.add_argument("--replica-n", type=int, default=None)
+    s.add_argument("--tls-certificate", default=None, help="PEM cert; serves HTTPS")
+    s.add_argument("--tls-key", default=None, help="PEM private key")
+    s.add_argument(
+        "--tls-skip-verify",
+        action="store_true",
+        help="trust self-signed peer certificates",
+    )
     s.set_defaults(fn=cmd_server)
 
     s = sub.add_parser("import", help="CSV import")
     s.add_argument("path", help="CSV file or - for stdin")
-    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port or https://host:port for TLS servers")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
     s.add_argument("-i", "--index", required=True)
     s.add_argument("-f", "--field", required=True)
     s.add_argument("--create", action="store_true", help="create index/field first")
@@ -192,7 +229,10 @@ def main(argv: list[str] | None = None) -> int:
     s.set_defaults(fn=cmd_import)
 
     s = sub.add_parser("export", help="CSV export")
-    s.add_argument("--host", default="127.0.0.1:10101")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port or https://host:port for TLS servers")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
     s.add_argument("-i", "--index", required=True)
     s.add_argument("-f", "--field", required=True)
     s.set_defaults(fn=cmd_export)

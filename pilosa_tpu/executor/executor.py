@@ -109,8 +109,11 @@ def _gb_counts(masks, matrix, rows):
     """GroupBy level counts: [G,S,W] masks × K candidate rows → int64[G,K]
     in one dispatch (lax.map bounds transient memory to one row batch)."""
     gathered = jnp.take(matrix, rows, axis=1, mode="fill", fill_value=0)
+    # popcount_rows accumulates the trailing axis in i32 (≤ 2^20 bits per
+    # row); i64 only for the [G,S] partials — an i64 [G,S,W] intermediate
+    # would relayout-copy the stack (see ops.bitwise.popcount)
     per_row = lambda rm: jnp.sum(
-        ops.popcount_words(masks & rm[None]).astype(jnp.int64), axis=(1, 2)
+        ops.popcount_rows(masks & rm[None]).astype(jnp.int64), axis=1
     )
     return jax.lax.map(per_row, jnp.moveaxis(gathered, 1, 0)).T
 
@@ -447,8 +450,8 @@ class Executor:
             ("topn_chunk", len(shards)),
             lambda: jax.jit(
                 lambda g, f: jnp.sum(
-                    ops.popcount_words(g & f[:, None, :]).astype(jnp.int64),
-                    axis=(0, 2),
+                    ops.popcount_rows(g & f[:, None, :]).astype(jnp.int64),
+                    axis=0,
                 )
             ),
         )

@@ -56,15 +56,29 @@ def popcount_words(words) -> jax.Array:
 
 
 def popcount(words) -> jax.Array:
-    """Total set bits over all axes → int32 scalar (safe: one shard row has
-    ≤ 2^20 bits; callers accumulate cross-shard totals in 64-bit on host or
-    via ``psum`` on an int64/float carrier — see executor)."""
-    return jnp.sum(popcount_words(words))
+    """Total set bits over all axes → int64 scalar.
+
+    Two-stage accumulation: the trailing word axis reduces in int32 (one
+    shard row holds ≤ 2^20 bits, so int32 cannot overflow), and only the
+    tiny per-row vector widens to int64 for the cross-row total. The
+    dtype staging matters for memory, not just overflow: with x64 on,
+    a bare ``jnp.sum`` promotes the FULL ``[..., W]`` popcount tensor to
+    int64 before reducing, and on TPU that int64 intermediate makes XLA
+    relayout-copy the whole packed operand — at 10B columns that is a
+    10 GiB HLO temp that OOMs HBM (measured 2026-07-30: the staged form
+    compiles with 0 B temp, the promoted form exceeds HBM by 4.25 G).
+    """
+    return jnp.sum(popcount_rows(words).astype(jnp.int64))
 
 
 def popcount_rows(matrix) -> jax.Array:
-    """Reduce the trailing word axis: ``uint32[..., W] → int32[...]``."""
-    return jnp.sum(popcount_words(matrix), axis=-1)
+    """Reduce the trailing word axis: ``uint32[..., W] → int32[...]``.
+
+    int32 accumulation is forced (not promoted to int64 under x64) — safe
+    per row (≤ 2^20 bits) and required so the packed operand keeps its
+    stored layout; see popcount() for the relayout-OOM rationale.
+    """
+    return jnp.sum(popcount_words(matrix), axis=-1, dtype=jnp.int32)
 
 
 # Fused op+count — these compile to a single XLA fusion (no materialized

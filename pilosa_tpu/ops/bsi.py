@@ -112,7 +112,7 @@ def between(slices, lo: int, hi: int):
 def sum_counts(slices, filt):
     """Per-magnitude-bit signed counts for Sum.
 
-    Returns (pos_counts int32[depth], neg_counts int32[depth], n int32):
+    Returns (pos_counts int32[depth], neg_counts int32[depth], n int64):
     the exact sum is Σ_k 2^k (pos[k] - neg[k]), accumulated by the caller
     in arbitrary precision (host Python ints, or an int64 dot on device —
     see ``sum_device``). Two-phase split keeps device counts in int32
@@ -138,7 +138,7 @@ def weigh_sum(pos_counts, neg_counts) -> int:
 
 
 def sum_device(slices, filt):
-    """All-device Sum → (sum int64, count int32). Used inside sharded
+    """All-device Sum → (sum int64, count int64). Used inside sharded
     programs where the result participates in a psum; needs x64 enabled
     (pilosa_tpu.ops turns it on at import)."""
     pos_counts, neg_counts, n = sum_counts(slices, filt)
@@ -149,7 +149,7 @@ def sum_device(slices, filt):
 
 
 def min_max(slices, filt, want_max: bool):
-    """(value int64, count int32) of the min/max stored value among
+    """(value int64, count int64) of the min/max stored value among
     filtered, existing columns. count==0 ⇒ no value (result undefined).
 
     Branch-free: computes both the positive-candidate walk and the

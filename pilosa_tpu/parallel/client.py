@@ -21,8 +21,22 @@ class PeerError(RuntimeError):
 
 
 class InternalClient:
-    def __init__(self, timeout: float = 30.0):
+    def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
         self.timeout = timeout
+        # reference: tls.skip-verify — trust self-signed peer certs on the
+        # node→node data plane. The context is built lazily so plain-HTTP
+        # clusters never import ssl.
+        self.skip_verify = skip_verify
+        self._ssl_ctx = None
+
+    def _context(self, uri: str):
+        if not (self.skip_verify and uri.startswith("https:")):
+            return None
+        if self._ssl_ctx is None:
+            import ssl
+
+            self._ssl_ctx = ssl._create_unverified_context()
+        return self._ssl_ctx
 
     def _request(
         self,
@@ -37,7 +51,9 @@ class InternalClient:
             req.add_header("Content-Type", "application/json")
         try:
             with urllib.request.urlopen(
-                req, timeout=self.timeout if timeout is None else timeout
+                req,
+                timeout=self.timeout if timeout is None else timeout,
+                context=self._context(uri),
             ) as resp:
                 return resp.read()
         except urllib.error.HTTPError as e:

@@ -56,14 +56,17 @@ class Cluster:
     def __init__(self, server):
         self.server = server
         self.config = server.config
-        self.client = InternalClient()
+        self.client = InternalClient(skip_verify=self.config.tls_skip_verify)
         me = Node(
             id=self.config.node_id,
             uri=server.uri,
             is_coordinator=self.config.coordinator,
         )
         peers = [
-            Node(id=uri.replace("http://", ""), uri=uri)
+            Node(
+                id=uri.replace("https://", "").replace("http://", ""),
+                uri=uri,
+            )
             for uri in self.config.seeds
             if uri.rstrip("/") != server.uri
         ]
@@ -248,7 +251,9 @@ class Cluster:
         (name vs host:port), so admin/peer messages may identify a node
         either way; the URI is canonical."""
         for n in self.nodes:
-            if n.id == ident or n.uri == ident or n.uri == f"http://{ident}":
+            if n.id == ident or n.uri == ident:
+                return n
+            if n.uri in (f"http://{ident}", f"https://{ident}"):
                 return n
             if uri and n.uri == uri:
                 return n

@@ -192,7 +192,7 @@ class MeshQueryEngine:
             out_specs=P(),
         )
         def prog(a, b):
-            local = jnp.sum(jax.lax.population_count(a & b).astype(jnp.int64))
+            local = ops.count_and(a, b)  # staged i32→i64 (see ops.popcount)
             return jax.lax.psum(jax.lax.psum(local, AXIS_WORDS), AXIS_SHARDS)
 
         return prog
@@ -209,10 +209,9 @@ class MeshQueryEngine:
             out_specs=P(),
         )
         def counts_prog(matrix, filt):
-            local = jnp.sum(
-                jax.lax.population_count(matrix & filt[:, None, :]).astype(jnp.int64),
-                axis=(0, 2),
-            )
+            # [S_local, R] i32; i64 only past this point (layout: count_and)
+            per = ops.popcount_rows(matrix & filt[:, None, :])
+            local = jnp.sum(per.astype(jnp.int64), axis=0)
             return jax.lax.psum(jax.lax.psum(local, AXIS_WORDS), AXIS_SHARDS)
 
         @functools.partial(jax.jit, static_argnums=(2,))
@@ -243,16 +242,10 @@ class MeshQueryEngine:
             neg = (exists & sign & filt)[:, None, :]
             depth = mag.shape[1]
             weights = jnp.asarray([1 << k for k in range(depth)], dtype=jnp.int64)
-            pc = jnp.sum(
-                jax.lax.population_count(mag & pos).astype(jnp.int64), axis=(0, 2)
-            )
-            nc = jnp.sum(
-                jax.lax.population_count(mag & neg).astype(jnp.int64), axis=(0, 2)
-            )
+            pc = jnp.sum(ops.popcount_rows(mag & pos).astype(jnp.int64), axis=0)
+            nc = jnp.sum(ops.popcount_rows(mag & neg).astype(jnp.int64), axis=0)
             local_sum = jnp.sum((pc - nc) * weights)
-            local_n = jnp.sum(
-                jax.lax.population_count(exists & filt).astype(jnp.int64)
-            )
+            local_n = ops.popcount(exists & filt)
             total = jax.lax.psum(jax.lax.psum(local_sum, AXIS_WORDS), AXIS_SHARDS)
             n = jax.lax.psum(jax.lax.psum(local_n, AXIS_WORDS), AXIS_SHARDS)
             return total, n
@@ -283,10 +276,10 @@ class MeshQueryEngine:
         def prog(matrix, delta, filt):
             new_matrix = matrix | delta
             local_counts = jnp.sum(
-                jax.lax.population_count(new_matrix & filt[:, None, :]).astype(
+                ops.popcount_rows(new_matrix & filt[:, None, :]).astype(
                     jnp.int64
                 ),
-                axis=(0, 2),
+                axis=0,
             )
             counts = jax.lax.psum(
                 jax.lax.psum(local_counts, AXIS_WORDS), AXIS_SHARDS

@@ -400,6 +400,7 @@ class HTTPServer(ThreadingHTTPServer):
 
     def __init__(self, addr: tuple[str, int], api, stats: StatsClient | None = None):
         super().__init__(addr, Handler)
+        self.ssl_context = None  # set by Server.open() for TLS serving
         self.api = api
         self.stats = stats or StatsClient()
         self.node_id = "local"
@@ -415,6 +416,19 @@ class HTTPServer(ThreadingHTTPServer):
             self.api.import_values(index, field, payload)
         else:
             self.api.import_bits(index, field, payload)
+
+    def get_request(self):
+        """Accept, then wrap per-connection for TLS with the handshake
+        DEFERRED (do_handshake_on_connect=False): get_request runs on the
+        single accept thread, so an inline handshake would let one stalled
+        client (TCP open, no ClientHello) wedge every other request; the
+        deferred handshake happens on first recv in the handler's thread."""
+        sock, addr = super().get_request()
+        if self.ssl_context is not None:
+            sock = self.ssl_context.wrap_socket(
+                sock, server_side=True, do_handshake_on_connect=False
+            )
+        return sock, addr
 
     def handle_extra(self, handler: Handler, method: str, path: str) -> bool:
         for (m, pattern), fn in self.extra_routes.items():

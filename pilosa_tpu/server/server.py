@@ -55,6 +55,20 @@ class Server:
         self.http = HTTPServer(
             (self.config.host, self.config.port), self.api, stats=self.stats
         )
+        if self.config.tls_certificate:
+            # serve HTTPS (reference: tls.certificate/tls.key). The context
+            # is handed to the listener, which wraps each accepted
+            # connection with a deferred handshake — see HTTPServer.
+            # get_request for why the listening socket itself must NOT be
+            # wrapped (handshake would run on the accept thread).
+            import ssl
+
+            ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            ctx.load_cert_chain(
+                os.path.expanduser(self.config.tls_certificate),
+                os.path.expanduser(self.config.tls_key) or None,
+            )
+            self.http.ssl_context = ctx
         self.http.node_id = self.config.node_id
         self.http.long_query_time = self.config.long_query_time
         if self.config.seeds or self.config.coordinator:
@@ -128,7 +142,7 @@ class Server:
 
     @property
     def uri(self) -> str:
-        return f"http://{self.config.host}:{self.port}"
+        return f"{self.config.scheme}://{self.config.host}:{self.port}"
 
     def close(self) -> None:
         self._closed = True

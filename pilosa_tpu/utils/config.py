@@ -46,6 +46,13 @@ class Config:
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
+    # TLS (reference: server/config.go tls.certificate / tls.key /
+    # tls.skip-verify). Setting certificate+key serves HTTPS; skip_verify
+    # disables peer-certificate verification on the internal client (for
+    # self-signed deployments, as upstream).
+    tls_certificate: str = ""
+    tls_key: str = ""
+    tls_skip_verify: bool = False
 
     @property
     def host(self) -> str:
@@ -56,8 +63,12 @@ class Config:
         return int(self.bind.split(":")[1])
 
     @property
+    def scheme(self) -> str:
+        return "https" if self.tls_certificate else "http"
+
+    @property
     def uri(self) -> str:
-        return f"http://{self.bind}"
+        return f"{self.scheme}://{self.bind}"
 
     @property
     def node_id(self) -> str:
@@ -127,6 +138,9 @@ def config_template() -> str:
         "mesh-enabled = true\n"
         "mesh-words-axis = 1\n"
         'metric-service = "prometheus"\n'
+        'tls-certificate = ""\n'
+        'tls-key = ""\n'
+        "tls-skip-verify = false\n"
     )
 
 
