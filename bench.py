@@ -4,7 +4,7 @@ multi-billion-column scale, through the REAL serving path.
 BASELINE.md north star: PQL Intersect+Count QPS and TopN p50 latency on a
 10B-column index. Unlike round 2 (which measured the raw fused kernel on
 two flat arrays), every timed query here goes through the executor/
-compiler: PQL AST → planner → StackCache-resident [S, R, W] device stack
+compiler: PQL AST → planner → StackCache-resident [R, S, W] device stack
 → compiled program → on-device reduction. The headline number is the
 pipelined executor QPS (`QueryCompiler.count_async`, readback overlapped
 — how a serving system issues queries); sync end-to-end latency
@@ -33,7 +33,7 @@ still yields a datapoint. Stage-by-stage progress goes to stderr.
 Scale knobs via env:
     PILOSA_BENCH_SHARDS        (default 10240 → 10240·2^20 ≈ 10.7B columns,
                                 the BASELINE.md north-star scale; an
-                                [S, 8, W] ≈ 10.7 GB stack resident in HBM)
+                                [8, S, W] ≈ 10.7 GB stack resident in HBM)
     PILOSA_BENCH_CPU_ITERS / PILOSA_BENCH_TPU_ITERS
     PILOSA_BENCH_INIT_TIMEOUT  (per-child backend-init watchdog, s)
     PILOSA_BENCH_TOTAL_BUDGET  (parent wall-clock budget, s)
@@ -52,7 +52,7 @@ INIT_TIMEOUT_S = float(os.environ.get("PILOSA_BENCH_INIT_TIMEOUT", "300"))
 TOTAL_BUDGET_S = float(os.environ.get("PILOSA_BENCH_TOTAL_BUDGET", "2700"))
 FULL_SHARDS = int(os.environ.get("PILOSA_BENCH_SHARDS", "10240"))
 R_PAD = 8  # field rows per fragment; the parent sizes the device budget
-# from this, the child builds the [S, R_PAD, W] stack with it
+# from this, the child builds the [R_PAD, S, W] stack with it
 
 
 def _stage(msg: dict) -> None:
@@ -173,7 +173,7 @@ def _child_main(n_shards: int) -> None:
     e2e_p50_ms = sorted(lats)[len(lats) // 2] * 1e3
 
     # ------------- TopN p50 (the other half of the north star): exact
-    # one-pass over the full [S, 8, W] stack, correctness-anchored
+    # one-pass over the full [8, S, W] stack, correctness-anchored
     # shard multiplicity of group g is closed-form over the s % G cycle
     row_counts = [
         sum(

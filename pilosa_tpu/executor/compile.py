@@ -5,8 +5,10 @@ reduces over HTTP. Here the whole read query becomes a single XLA
 program over *stacked* field arrays:
 
 - each (field, view) keeps a device-resident stacked matrix
-  ``uint32[S, R, W]`` (S = shards, R = padded rows) rebuilt only when a
-  fragment version changes — uploads are amortized across queries;
+  ``uint32[R, S, W]`` (R = padded rows, S = shards; row-major so a row
+  gather reads one contiguous [S, W] plane — see stack_view_matrices)
+  rebuilt only when a fragment version changes — uploads are amortized
+  across queries;
 - a call tree compiles to a closure over (matrix, row_id) leaf inputs;
   row IDs are traced scalars, so one compiled program serves every row
   of the same query shape (Count(Intersect(Row, Row)) compiles once);
@@ -51,7 +53,7 @@ class PlanError(ValueError):
 
 
 class StackOverBudget(Exception):
-    """A field's dense [S, R, W] stack would exceed the device budget.
+    """A field's dense [R, S, W] stack would exceed the device budget.
 
     Raised EXPLICITLY instead of letting the allocation OOM (SURVEY §7
     hard part (e)). Callers fall back: Row() leaves go through the
@@ -71,11 +73,19 @@ class StackOverBudget(Exception):
 
 # --------------------------------------------------------------- stacking
 def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
-    """Stack a view's fragment host matrices → (np uint32[S, R, W], R).
+    """Stack a view's fragment host matrices → (np uint32[R, S, W], R).
 
     Shared by the query compiler's StackCache and the mesh engine
     (parallel/mesh.py). Reads fragment HOST matrices — no per-fragment
     device round trips; the caller does one upload for the whole stack.
+
+    ROW-MAJOR ([R, S, W], not [S, R, W]) is load-bearing for query
+    latency: TPU tiles the two minor dims, so with rows as a middle dim
+    a tile spans all R rows of 128 words and gathering ONE row streams
+    the ENTIRE stack through the VPU (measured 2026-07-30 at 10.7B
+    columns: 29.9 ms/query ≈ whole-stack read at roofline). With rows
+    leading, a row gather is a contiguous [S, W] plane — only the rows a
+    query touches cross HBM.
     """
     mats, max_rows = [], 1
     for s in shards:
@@ -86,17 +96,22 @@ def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
             m, _n = frag.host_matrix()
             mats.append(m)
             max_rows = max(max_rows, m.shape[0])
-    stacked = np.zeros((len(shards), max_rows, WORDS_PER_SHARD), dtype=np.uint32)
+    stacked = np.zeros((max_rows, len(shards), WORDS_PER_SHARD), dtype=np.uint32)
     for i, m in enumerate(mats):
         if m is not None:
-            stacked[i, : m.shape[0]] = m
+            stacked[: m.shape[0], i] = m
     return stacked, max_rows
+
+
+# scatter index sentinel: out of bounds on any axis ⇒ mode="drop" skips it
+_OOB = np.int32(2**30)
 
 
 @jax.jit
 def _apply_stack_delta(matrix, idx, rows):
-    """Scatter ``rows[k]`` into ``matrix[idx[k,0], idx[k,1]]`` on device.
-    Padding entries use an out-of-bounds shard index and are dropped.
+    """Scatter ``rows[k]`` into ``matrix[idx[k,0], idx[k,1]]`` on device
+    (row-major stacks: idx columns are (row, shard)). Padding entries use
+    the _OOB sentinel and are dropped.
     Deliberately NOT donated: concurrent readers may still hold the old
     stack; the device-to-device copy rides HBM bandwidth, which is the
     point — the host→device upload is what O(dirty rows) avoids."""
@@ -151,7 +166,7 @@ class StackCache:
         return _pad_rows(n)
 
     def matrix(self, idx: Index, field: Field, view_name: str, shards: list[int]):
-        """(jnp uint32[S, R, W], n_rows int) for the given shard list.
+        """(jnp uint32[R, S, W], n_rows int) for the given shard list.
 
         Raises StackOverBudget when the dense stack would exceed
         STACK_BYTES_BUDGET — callers use hot_slot()/hot_dev() or chunked
@@ -252,11 +267,10 @@ class StackCache:
         if not updates:
             return (versions, dev, max_rows, view_ver)
         k_pad = 1 << (len(updates) - 1).bit_length()
-        n_shards = len(shards)
-        idx_arr = np.full((k_pad, 2), n_shards, dtype=np.int32)  # OOB ⇒ drop
+        idx_arr = np.full((k_pad, 2), _OOB, dtype=np.int32)  # OOB ⇒ drop
         row_arr = np.zeros((k_pad, WORDS_PER_SHARD), dtype=np.uint32)
         for k, (i, r, words) in enumerate(updates):
-            idx_arr[k] = (i, r)
+            idx_arr[k] = (r, i)
             row_arr[k] = words
         new_dev = _apply_stack_delta(dev, idx_arr, row_arr)
         if new_dev.sharding != dev.sharding:
@@ -293,7 +307,7 @@ class StackCache:
 
     # ----------------------------------------------------- hot-row stacks
     # High-cardinality fields (dense stack over STACK_BYTES_BUDGET) keep
-    # only an LRU working set of rows on device: a [S, H, W] slot stack
+    # only an LRU working set of rows on device: an [H, S, W] slot stack
     # plus a row→slot map. Cold rows live in the host roaring bitmaps and
     # are promoted on first touch with an O(S·W) scatter — never a full
     # host matrix (SURVEY §7 hard part (e)).
@@ -324,7 +338,7 @@ class StackCache:
         if entry is None or entry["h"] != h:
             from collections import OrderedDict
 
-            zeros = np.zeros((len(shards), h, WORDS_PER_SHARD), dtype=np.uint32)
+            zeros = np.zeros((h, len(shards), WORDS_PER_SHARD), dtype=np.uint32)
             dev = (
                 self.mesh_ctx.place_stack(zeros)
                 if self.mesh_ctx is not None
@@ -388,7 +402,7 @@ class StackCache:
                 frag = view.fragment(s) if view else None
                 if frag is not None:
                     data[j * n_s + i] = frag.row_packed(row_id)
-                idx_arr[j * n_s + i] = (i, slot)
+                idx_arr[j * n_s + i] = (slot, i)
         new_dev = _apply_stack_delta(entry["dev"], idx_arr, data)
         if new_dev.sharding != entry["dev"].sharding:
             new_dev = jax.device_put(new_dev, entry["dev"].sharding)
@@ -406,7 +420,7 @@ class StackCache:
         row_ids: list[int],
     ):
         """Atomically ensure EVERY row in ``row_ids`` is device-resident
-        and return ``(dev [S,H,W], {row_id: slot})`` captured in one
+        and return ``(dev [H,S,W], {row_id: slot})`` captured in one
         critical section. The returned array object is immutable — later
         evictions by other queries scatter into a NEW array, so a
         program compiled against this (dev, slots) pair can never read a
@@ -522,8 +536,10 @@ class _Planner:
         def run(arrays, scalars):
             m = arrays[ai]
             row = scalars[si]
-            # out-of-range / -1 rows read as zeros
-            return jnp.take(m, row, axis=1, mode="fill", fill_value=0)
+            # out-of-range / -1 rows read as zeros; axis 0 of the
+            # row-major stack — a contiguous [S, W] plane, so the gather
+            # reads only this row's bytes (see stack_view_matrices)
+            return jnp.take(m, row, axis=0, mode="fill", fill_value=0)
 
         return run, f"row({mode}:{field.name}/{view_name})"
 
@@ -541,7 +557,7 @@ class _Planner:
         return self._matrix_leaf(ef, VIEW_STANDARD, 0)
 
     def _bsi(self, field: Field):
-        """closure → uint32[S, D, W] bit-slice block."""
+        """closure → uint32[D, S, W] bit-slice block (row-major stack)."""
         ai = self._add_array(
             ("bsi", field.name),
             lambda: self.stacks.matrix(self.idx, field, VIEW_BSI, self.shards)[0],
@@ -550,9 +566,9 @@ class _Planner:
 
         def run(arrays, scalars):
             m = arrays[ai]
-            if m.shape[1] < need:
-                m = jnp.pad(m, ((0, 0), (0, need - m.shape[1]), (0, 0)))
-            return m[:, :need]
+            if m.shape[0] < need:
+                m = jnp.pad(m, ((0, need - m.shape[0]), (0, 0), (0, 0)))
+            return m[:need]
 
         return run, f"bsi({field.name}:{field.bit_depth})"
 
@@ -682,17 +698,18 @@ class _Planner:
         if value is None:
             if op == "!=":
                 return (
-                    lambda arrays, scalars: bsi(arrays, scalars)[:, 0]
+                    lambda arrays, scalars: bsi(arrays, scalars)[0]
                 ), f"notnull({bkey})"
             if op == "==":
                 return (
                     lambda arrays, scalars: ex(arrays, scalars)
-                    & ~bsi(arrays, scalars)[:, 0]
+                    & ~bsi(arrays, scalars)[0]
                 ), f"isnull({bkey})"
             raise PlanError(f"null only supports ==/!= comparisons, got {op!r}")
 
-        vmapped_between = jax.vmap(ops.bsi.between, in_axes=(0, None, None))
-        vmapped_cmp = jax.vmap(ops.bsi.compare, in_axes=(0, None, None))
+        # vmap over the shard axis (axis 1 of the [D, S, W] block)
+        vmapped_between = jax.vmap(ops.bsi.between, in_axes=(1, None, None))
+        vmapped_cmp = jax.vmap(ops.bsi.compare, in_axes=(1, None, None))
         if op == "between":
             lo, hi = int(value[0]), int(value[1])
             return (

@@ -7,7 +7,7 @@ executeRows, executeSet/Clear…, mapReduce, mapperLocal/mapperRemote).
 Redesigned for TPU:
 
 - every read query executes as ONE jitted program over stacked
-  ``uint32[S, R, W]`` field arrays (see executor/compile.py) — the
+  ``uint32[R, S, W]`` field arrays (row-major; see executor/compile.py) — the
   reference's per-shard goroutine fan-out and HTTP reduce collapse into a
   single XLA dispatch with on-device reductions;
 - aggregates (Count/Sum/Min/Max/TopN) reduce on device; only scalars (or
@@ -106,25 +106,27 @@ class ExecutionError(ValueError):
 
 @jax.jit
 def _gb_counts(masks, matrix, rows):
-    """GroupBy level counts: [G,S,W] masks × K candidate rows → int64[G,K]
-    in one dispatch (lax.map bounds transient memory to one row batch)."""
-    gathered = jnp.take(matrix, rows, axis=1, mode="fill", fill_value=0)
+    """GroupBy level counts: [G,S,W] masks × K candidate rows (gathered
+    from the [R,S,W] row-major stack) → int64[G,K] in one dispatch
+    (lax.map bounds transient memory to one row batch)."""
+    gathered = jnp.take(matrix, rows, axis=0, mode="fill", fill_value=0)
     # popcount_rows accumulates the trailing axis in i32 (≤ 2^20 bits per
     # row); i64 only for the [G,S] partials — an i64 [G,S,W] intermediate
     # would relayout-copy the stack (see ops.bitwise.popcount)
     per_row = lambda rm: jnp.sum(
         ops.popcount_rows(masks & rm[None]).astype(jnp.int64), axis=1
     )
-    return jax.lax.map(per_row, jnp.moveaxis(gathered, 1, 0)).T
+    return jax.lax.map(per_row, gathered).T
 
 
 @jax.jit
 def _gb_masks(masks, matrix, g_idx, row_sel):
     """Materialize surviving groups' masks: gather parent masks and
-    candidate rows, AND them — one dispatch per level."""
+    candidate rows (axis 0 of the row-major stack), AND them — one
+    dispatch per level."""
     sel = jnp.take(masks, g_idx, axis=0)
-    rows = jnp.take(matrix, row_sel, axis=1, mode="fill", fill_value=0)
-    return sel & jnp.moveaxis(rows, 1, 0)
+    rows = jnp.take(matrix, row_sel, axis=0, mode="fill", fill_value=0)
+    return sel & rows
 
 
 class SumCount(dict):
@@ -291,27 +293,29 @@ class Executor:
         return self.compiler.ones(len(shards))
 
     def _bsi_stacked(self, idx: Index, field: Field, shards: list[int]):
-        """uint32[S, D, W] bit-slice block for an int field (device).
-        BSI depth is ≤ 66 rows, so the budget can only trip on huge shard
-        lists — surface it clearly if it does."""
+        """uint32[D, S, W] bit-slice block for an int field (device,
+        row-major like every stack). BSI depth is ≤ 66 rows, so the
+        budget can only trip on huge shard lists — surface it clearly if
+        it does."""
         try:
             m, _rows = self.compiler.stacks.matrix(idx, field, VIEW_BSI, shards)
         except StackOverBudget as e:
             raise ExecutionError(str(e)) from e
         need = BSI_OFFSET + field.bit_depth
-        if m.shape[1] < need:
-            m = jnp.pad(m, ((0, 0), (0, need - m.shape[1]), (0, 0)))
-        return m[:, :need]
+        if m.shape[0] < need:
+            m = jnp.pad(m, ((0, need - m.shape[0]), (0, 0), (0, 0)))
+        return m[:need]
 
     # ------------------------------------------------------- aggregates
     @staticmethod
     def _sum_fn(s, f):
-        """(slices [S,D,W], filt [S,W]) → (pos[D], neg[D], n) — the ONE
+        """(slices [D,S,W], filt [S,W]) → (pos[D], neg[D], n) — the ONE
         BSI-sum reduction body; Sum jits it directly and GroupBy's
-        aggregate wraps it in a group vmap so the two stay in sync."""
+        aggregate wraps it in a group vmap so the two stay in sync.
+        vmap over the shard axis (axis 1 of the row-major block)."""
         return tuple(
             x.astype(jnp.int64).sum(axis=0)
-            for x in jax.vmap(ops.bsi.sum_counts)(s, f)
+            for x in jax.vmap(ops.bsi.sum_counts, in_axes=(1, 0))(s, f)
         )
 
     def _sum_program(self, field: Field, n_shards: int):
@@ -321,7 +325,7 @@ class Executor:
         )
 
     def _grouped_sum_program(self, field: Field, n_shards: int):
-        """(slices [S,D,W], masks [G,S,W]) → (pos[G,D], neg[G,D], n[G])."""
+        """(slices [D,S,W], masks [G,S,W]) → (pos[G,D], neg[G,D], n[G])."""
         return self.compiler.program(
             ("gb_sums", n_shards, field.bit_depth),
             lambda: jax.jit(jax.vmap(self._sum_fn, in_axes=(None, 0))),
@@ -345,7 +349,8 @@ class Executor:
             ("minmax", len(shards), field.bit_depth, want_max),
             lambda: jax.jit(
                 lambda s, f: jax.vmap(
-                    lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max)
+                    lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
+                    in_axes=(1, 0),
                 )(s, f)
             ),
         )
@@ -385,7 +390,7 @@ class Executor:
                 ("topn_ids", len(shards)),
                 lambda: jax.jit(
                     lambda m, r, f: jax.vmap(
-                        ops.topn.candidate_counts, in_axes=(0, None, 0)
+                        ops.topn.candidate_counts, in_axes=(1, None, 0)
                     )(m, r, f)
                     .astype(jnp.int64)
                     .sum(axis=0)
@@ -399,7 +404,9 @@ class Executor:
             prog = self.compiler.program(
                 ("topn", len(shards)),
                 lambda: jax.jit(
-                    lambda m, f: jax.vmap(ops.matrix_filter_counts)(m, f)
+                    lambda m, f: jax.vmap(ops.matrix_filter_counts, in_axes=(1, 0))(
+                        m, f
+                    )
                     .astype(jnp.int64)
                     .sum(axis=0)
                 ),
@@ -449,9 +456,10 @@ class Executor:
         prog = self.compiler.program(
             ("topn_chunk", len(shards)),
             lambda: jax.jit(
+                # g [C,S,W] row-major chunk, f [S,W] → int64[C]
                 lambda g, f: jnp.sum(
-                    ops.popcount_rows(g & f[:, None, :]).astype(jnp.int64),
-                    axis=0,
+                    ops.popcount_rows(g & f[None]).astype(jnp.int64),
+                    axis=1,
                 )
             ),
         )
@@ -459,13 +467,13 @@ class Executor:
         for lo in range(0, len(rows), chunk):
             sub = rows[lo : lo + chunk]
             host = np.zeros(
-                (len(shards), len(sub), WORDS_PER_SHARD), dtype=np.uint32
+                (len(sub), len(shards), WORDS_PER_SHARD), dtype=np.uint32
             )
             for i, frag in enumerate(frags):
                 if frag is None:
                     continue
                 for j, r in enumerate(sub):
-                    host[i, j] = frag.row_packed(r)
+                    host[j, i] = frag.row_packed(r)
             counts = np.asarray(prog(jnp.asarray(host), filt))
             for j, r in enumerate(sub):
                 if counts[j] > 0:
@@ -620,9 +628,10 @@ class Executor:
         pack_cache: OrderedDict[tuple[int, int], np.ndarray] = OrderedDict()
 
         def _pack_rows(level: int, frags: list, rows: list[int], k_pad: int) -> np.ndarray:
-            """Host-pack [S, k_pad, W] for a streamed level's row subset;
-            padding rows stay zero so their counts/masks are zero."""
-            host = np.zeros((n_shards, k_pad, WORDS_PER_SHARD), dtype=np.uint32)
+            """Host-pack [k_pad, S, W] (row-major, like resident stacks)
+            for a streamed level's row subset; padding rows stay zero so
+            their counts/masks are zero."""
+            host = np.zeros((k_pad, n_shards, WORDS_PER_SHARD), dtype=np.uint32)
             for j, r in enumerate(rows):
                 key = (level, r)
                 got = pack_cache.get(key)
@@ -640,7 +649,7 @@ class Executor:
                         pack_cache.popitem(last=False)
                 else:
                     pack_cache.move_to_end(key)
-                host[:, j] = got
+                host[j] = got
             return host
 
         def _level_counts(level: int, masks, n_groups: int) -> np.ndarray:

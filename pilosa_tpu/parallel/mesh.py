@@ -14,10 +14,11 @@ reduce is an XLA collective over ICI, not an HTTP merge:
   chip); cross-device ops on it are elementwise, only aggregations
   communicate (psum tree over ICI).
 
-Arrays:
-    row matrix   uint32[S, R, W]  sharded P("shards", None, "words")
+Arrays (row-major: rows lead so a row gather reads a contiguous [S, W]
+plane — see executor.compile.stack_view_matrices for the measured why):
+    row matrix   uint32[R, S, W]  sharded P(None, "shards", "words")
     row/filter   uint32[S, W]     sharded P("shards", "words")
-    BSI slices   uint32[S, D, W]  sharded P("shards", None, "words")
+    BSI slices   uint32[D, S, W]  sharded P(None, "shards", "words")
 
 All counts psum over both axes; TopN does a words-then-shards psum of the
 per-row count vector, then a replicated top_k (the reference's two-phase
@@ -95,19 +96,22 @@ class MeshContext:
     def n_devices(self) -> int:
         return self.mesh.devices.size
 
-    def _spec(self, n_shards: int, n_words: int, middle_dims: int) -> P:
+    def _spec(self, n_shards: int, n_words: int, lead_dims: int) -> P:
         """Placement rule: shard the S axis over the mesh when it divides
         evenly (the data-parallel layout — whole shards per device);
         otherwise shard the packed word axis over ALL devices (always a
         power of two, so any shard count — even S=1 — still uses the full
         mesh); tiny odd shapes replicate. ``jax.device_put`` requires
-        exact divisibility, hence the explicit rule instead of padding."""
+        exact divisibility, hence the explicit rule instead of padding.
+        ``lead_dims`` is the number of leading (row) dims BEFORE the shard
+        axis — row-major stacks are [R, S, W], so the shards axis sits at
+        position ``lead_dims``."""
         shard_rows = self.mesh.shape[AXIS_SHARDS]
-        middle = (None,) * middle_dims
+        lead = (None,) * lead_dims
         if n_shards % shard_rows == 0 and n_words % self.mesh.shape[AXIS_WORDS] == 0:
-            return P(AXIS_SHARDS, *middle, AXIS_WORDS)
+            return P(*lead, AXIS_SHARDS, AXIS_WORDS)
         if n_words % self.n_devices == 0:
-            return P(None, *middle, (AXIS_SHARDS, AXIS_WORDS))
+            return P(*lead, None, (AXIS_SHARDS, AXIS_WORDS))
         return P()
 
     def _check_uniform_s(self, s: int) -> None:
@@ -129,28 +133,30 @@ class MeshContext:
                 "same S (empty shards are all-zero rows)"
             )
 
-    def _place(self, arr, middle_dims: int):
-        s = arr.shape[0]
+    def _place(self, arr, lead_dims: int):
+        s = arr.shape[lead_dims]
         w = arr.shape[-1]
         if self.multihost:
             n_proc = jax.process_count()
             self._check_uniform_s(s)
             s_global = s * n_proc
-            spec = self._spec(s_global, w, middle_dims)
-            if len(spec) == 0 or spec[0] != AXIS_SHARDS:
+            spec = self._spec(s_global, w, lead_dims)
+            if len(spec) <= lead_dims or spec[lead_dims] != AXIS_SHARDS:
                 raise ValueError(
                     f"multi-host placement needs the shards axis sharded: "
                     f"global S={s_global} not divisible by mesh "
                     f"{self.mesh.shape[AXIS_SHARDS]} shard rows"
                 )
-            global_shape = (s_global,) + arr.shape[1:]
+            global_shape = (
+                arr.shape[:lead_dims] + (s_global,) + arr.shape[lead_dims + 1 :]
+            )
             return jax.make_array_from_process_local_data(
                 NamedSharding(self.mesh, spec), arr, global_shape
             )
-        return jax.device_put(arr, NamedSharding(self.mesh, self._spec(s, w, middle_dims)))
+        return jax.device_put(arr, NamedSharding(self.mesh, self._spec(s, w, lead_dims)))
 
     def place_stack(self, stacked):
-        """uint32[S, R, W] (or [S, D, W] BSI block) → sharded device array.
+        """uint32[R, S, W] (or [D, S, W] BSI block) → sharded device array.
         Multi-host: S is this process's shard count; the global array
         concatenates every process's slice along S."""
         return self._place(stacked, 1)
@@ -168,13 +174,13 @@ class MeshQueryEngine:
 
     # ------------------------------------------------------------ placement
     def spec_matrix(self) -> NamedSharding:
-        return NamedSharding(self.mesh, P(AXIS_SHARDS, None, AXIS_WORDS))
+        return NamedSharding(self.mesh, P(None, AXIS_SHARDS, AXIS_WORDS))
 
     def spec_row(self) -> NamedSharding:
         return NamedSharding(self.mesh, P(AXIS_SHARDS, AXIS_WORDS))
 
     def place_matrix(self, stacked: np.ndarray):
-        """uint32[S, R, W] → device, sharded over (shards, words)."""
+        """uint32[R, S, W] (row-major) → device, sharded (shards, words)."""
         return jax.device_put(stacked, self.spec_matrix())
 
     def place_row(self, stacked: np.ndarray):
@@ -199,19 +205,19 @@ class MeshQueryEngine:
 
     @functools.cached_property
     def topn(self):
-        """(matrix [S,R,W], filt [S,W]) → per-row global counts int64[R]
+        """(matrix [R,S,W], filt [S,W]) → per-row global counts int64[R]
         (psum over both axes; top_k happens on the replicated vector)."""
 
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            in_specs=(P(None, AXIS_SHARDS, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
             out_specs=P(),
         )
         def counts_prog(matrix, filt):
-            # [S_local, R] i32; i64 only past this point (layout: count_and)
-            per = ops.popcount_rows(matrix & filt[:, None, :])
-            local = jnp.sum(per.astype(jnp.int64), axis=0)
+            # [R, S_local] i32; i64 only past this point (layout: count_and)
+            per = ops.popcount_rows(matrix & filt[None])
+            local = jnp.sum(per.astype(jnp.int64), axis=1)
             return jax.lax.psum(jax.lax.psum(local, AXIS_WORDS), AXIS_SHARDS)
 
         @functools.partial(jax.jit, static_argnums=(2,))
@@ -225,25 +231,25 @@ class MeshQueryEngine:
 
     @functools.cached_property
     def bsi_sum(self):
-        """(slices [S,D,W], filt [S,W]) → (sum int64, count int64)."""
+        """(slices [D,S,W], filt [S,W]) → (sum int64, count int64)."""
 
         @jax.jit
         @functools.partial(
             shard_map,
             mesh=self.mesh,
-            in_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            in_specs=(P(None, AXIS_SHARDS, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
             out_specs=(P(), P()),
         )
         def prog(slices, filt):
-            exists = slices[:, bsi_ops.EXISTS_ROW]
-            sign = slices[:, bsi_ops.SIGN_ROW]
-            mag = slices[:, bsi_ops.OFFSET_ROW :]
-            pos = (exists & ~sign & filt)[:, None, :]
-            neg = (exists & sign & filt)[:, None, :]
-            depth = mag.shape[1]
+            exists = slices[bsi_ops.EXISTS_ROW]
+            sign = slices[bsi_ops.SIGN_ROW]
+            mag = slices[bsi_ops.OFFSET_ROW :]
+            pos = (exists & ~sign & filt)[None]
+            neg = (exists & sign & filt)[None]
+            depth = mag.shape[0]
             weights = jnp.asarray([1 << k for k in range(depth)], dtype=jnp.int64)
-            pc = jnp.sum(ops.popcount_rows(mag & pos).astype(jnp.int64), axis=0)
-            nc = jnp.sum(ops.popcount_rows(mag & neg).astype(jnp.int64), axis=0)
+            pc = jnp.sum(ops.popcount_rows(mag & pos).astype(jnp.int64), axis=1)
+            nc = jnp.sum(ops.popcount_rows(mag & neg).astype(jnp.int64), axis=1)
             local_sum = jnp.sum((pc - nc) * weights)
             local_n = ops.popcount(exists & filt)
             total = jax.lax.psum(jax.lax.psum(local_sum, AXIS_WORDS), AXIS_SHARDS)
@@ -259,7 +265,7 @@ class MeshQueryEngine:
         the standing aggregates — one compiled program, zero host round
         trips (reference analogue: fragment.bulkImport + executor pass).
 
-        (matrix [S,R,W], delta [S,R,W], filt [S,W])
+        (matrix [R,S,W], delta [R,S,W], filt [S,W])
             → (new_matrix, per-row counts int64[R], total int64)
         """
 
@@ -267,19 +273,17 @@ class MeshQueryEngine:
             shard_map,
             mesh=self.mesh,
             in_specs=(
-                P(AXIS_SHARDS, None, AXIS_WORDS),
-                P(AXIS_SHARDS, None, AXIS_WORDS),
+                P(None, AXIS_SHARDS, AXIS_WORDS),
+                P(None, AXIS_SHARDS, AXIS_WORDS),
                 P(AXIS_SHARDS, AXIS_WORDS),
             ),
-            out_specs=(P(AXIS_SHARDS, None, AXIS_WORDS), P(), P()),
+            out_specs=(P(None, AXIS_SHARDS, AXIS_WORDS), P(), P()),
         )
         def prog(matrix, delta, filt):
             new_matrix = matrix | delta
             local_counts = jnp.sum(
-                ops.popcount_rows(new_matrix & filt[:, None, :]).astype(
-                    jnp.int64
-                ),
-                axis=0,
+                ops.popcount_rows(new_matrix & filt[None]).astype(jnp.int64),
+                axis=1,
             )
             counts = jax.lax.psum(
                 jax.lax.psum(local_counts, AXIS_WORDS), AXIS_SHARDS
@@ -291,8 +295,8 @@ class MeshQueryEngine:
 
 
 def stack_field_matrices(field, shards: list[int]) -> np.ndarray:
-    """Stack a field's standard-view fragment matrices → uint32[S, R, W]
-    (host-side; rows padded to the max across shards)."""
+    """Stack a field's standard-view fragment matrices → uint32[R, S, W]
+    (host-side, row-major; rows padded to the max across shards)."""
     from pilosa_tpu.core import VIEW_STANDARD
     from pilosa_tpu.executor.compile import stack_view_matrices
 

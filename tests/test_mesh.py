@@ -39,12 +39,12 @@ def test_mesh_count_and_matches_oracle(rng, engine):
 
 def test_mesh_topn_matches_oracle(rng, engine):
     S, R = 4, 16
-    matrix = np.zeros((S, R, WORDS_PER_SHARD), dtype=np.uint32)
+    matrix = np.zeros((R, S, WORDS_PER_SHARD), dtype=np.uint32)
     sets_ = {}
     for s in range(S):
         for r in range(R):
             cols = np.flatnonzero(rng.random(SHARD_WIDTH) < 0.1).astype(np.int64)
-            matrix[s, r] = pack_positions(cols, SHARD_WIDTH)
+            matrix[r, s] = pack_positions(cols, SHARD_WIDTH)
             sets_[(s, r)] = set(cols)
     filt, fcols = random_stack(rng, S, density=0.5)
     fsets = [set(c) for c in fcols]
@@ -61,17 +61,17 @@ def test_mesh_topn_matches_oracle(rng, engine):
 def test_mesh_bsi_sum_matches_oracle(rng, engine):
     S, n_vals = 4, 2000
     depth = 10
-    slices = np.zeros((S, 2 + depth, WORDS_PER_SHARD), dtype=np.uint32)
+    slices = np.zeros((2 + depth, S, WORDS_PER_SHARD), dtype=np.uint32)
     oracle_sum, oracle_n = 0, 0
     filt_stack, fcols = random_stack(rng, S, density=0.5)
     for s in range(S):
         cols = np.sort(rng.choice(SHARD_WIDTH, n_vals, replace=False)).astype(np.int64)
         vals = rng.integers(-500, 500, n_vals)
-        slices[s, 0] = pack_positions(cols, SHARD_WIDTH)
-        slices[s, 1] = pack_positions(cols[vals < 0], SHARD_WIDTH)
+        slices[0, s] = pack_positions(cols, SHARD_WIDTH)
+        slices[1, s] = pack_positions(cols[vals < 0], SHARD_WIDTH)
         mags = np.abs(vals)
         for k in range(depth):
-            slices[s, 2 + k] = pack_positions(cols[(mags >> k) & 1 == 1], SHARD_WIDTH)
+            slices[2 + k, s] = pack_positions(cols[(mags >> k) & 1 == 1], SHARD_WIDTH)
         fset = set(fcols[s])
         sel = [v for c, v in zip(cols.tolist(), vals.tolist()) if c in fset]
         oracle_sum += sum(sel)
@@ -84,11 +84,11 @@ def test_mesh_bsi_sum_matches_oracle(rng, engine):
 
 def test_mesh_ingest_and_aggregate(rng, engine):
     S, R = 4, 8
-    matrix = np.zeros((S, R, WORDS_PER_SHARD), dtype=np.uint32)
+    matrix = np.zeros((R, S, WORDS_PER_SHARD), dtype=np.uint32)
     matrix[0, 0, 0] = 0b1011
     delta = np.zeros_like(matrix)
-    delta[1, 0, 0] = 0b0100
-    delta[0, 3, 1] = 0b1
+    delta[0, 1, 0] = 0b0100
+    delta[3, 0, 1] = 0b1
     filt = np.full((S, WORDS_PER_SHARD), 0xFFFFFFFF, dtype=np.uint32)
     new_m, counts, total = engine.ingest_and_aggregate(
         engine.place_matrix(matrix), engine.place_matrix(delta), engine.place_row(filt)
