@@ -1,0 +1,25 @@
+import os, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["PILOSA_TPU_STACK_BUDGET"] = str(64 << 30)
+import numpy as np
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor.compile import stack_view_matrices
+from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+
+S = 10240
+rng = np.random.default_rng(7)
+G = 64
+blocks = [rng.integers(0, 2**32, (8, WORDS_PER_SHARD), dtype=np.uint32) for _ in range(G)]
+h = Holder(None)
+idx = h.create_index("b")
+f = idx.create_field("f")
+view = f.create_view_if_not_exists("standard")
+for s in range(S):
+    frag = view.create_fragment_if_not_exists(s)
+    frag._np_matrix = blocks[s % G]
+    frag._all_dirty = False
+
+t0 = time.perf_counter()
+stacked, max_rows = stack_view_matrices(view, list(range(S)))
+t1 = time.perf_counter()
+print(f"stack_view_matrices: {t1-t0:.1f} s for {stacked.nbytes/2**30:.1f} GiB")

@@ -172,6 +172,22 @@ def _child_main(n_shards: int) -> None:
         lats.append(time.perf_counter() - t0)
     e2e_p50_ms = sorted(lats)[len(lats) // 2] * 1e3
 
+    # transport floor: a trivial sync dispatch+readback. On a tunneled
+    # (remote) accelerator this RTT dominates every SYNC p50 — report it
+    # so e2e/TopN latencies are interpretable (device work is the delta)
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda v: v + 1)
+    tz = jnp.zeros((8,), jnp.int32)
+    np.asarray(tiny(tz))
+    lats = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        np.asarray(tiny(tz))
+        lats.append(time.perf_counter() - t0)
+    rtt_ms = sorted(lats)[len(lats) // 2] * 1e3
+    _stage({"stage": "transport_rtt", "ms": round(rtt_ms, 1)})
+
     # ------------- TopN p50 (the other half of the north star): exact
     # one-pass over the full [8, S, W] stack, correctness-anchored
     # shard multiplicity of group g is closed-form over the s % G cycle
@@ -211,6 +227,7 @@ def _child_main(n_shards: int) -> None:
                 "path": "executor_pipelined",
                 "e2e_p50_ms": round(e2e_p50_ms, 2),
                 "topn_p50_ms": round(topn_p50_ms, 2),
+                "transport_rtt_ms": round(rtt_ms, 1),
                 "hbm_gbps": round(gbps, 1),
             }
         ),

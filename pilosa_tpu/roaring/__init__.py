@@ -20,6 +20,7 @@ from pilosa_tpu.roaring.serialize import (
     deserialize,
     replay_ops,
     serialize,
+    serialize_official,
 )
 
 __all__ = [
@@ -30,6 +31,7 @@ __all__ = [
     "unpack_words",
     "words_count",
     "serialize",
+    "serialize_official",
     "deserialize",
     "append_op",
     "replay_ops",

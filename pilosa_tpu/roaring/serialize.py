@@ -107,6 +107,64 @@ def serialize(bitmap: Bitmap, compact_in_place: bool = False) -> bytes:
     return buf.getvalue()
 
 
+def serialize_official(bitmap: Bitmap) -> bytes:
+    """Serialize to the OFFICIAL 32-bit roaring interchange layout
+    (RoaringFormatSpec, cookies 12346/12347) — the format stock
+    CRoaring/RoaringBitmap clients parse. Only the low 2^32 positions
+    are representable (container keys ≤ 0xFFFF, the 32-bit space's high
+    half); higher keys raise ValueError.
+
+    Containers are run-compacted on the way out like serialize(); run
+    payloads are written as (start, length-1) pairs per the spec (the
+    internal form is (start, last))."""
+    keys = sorted(bitmap._containers)
+    if keys and keys[-1] > 0xFFFF:
+        raise ValueError(
+            f"official roaring format is 32-bit: container key {keys[-1]} "
+            "exceeds 0xFFFF (value ≥ 2^32)"
+        )
+    conts = []
+    for key in keys:
+        c = bitmap._containers[key]
+        if c.type != ct.TYPE_RUN:
+            c = ct.optimize(c, runs=True)
+        conts.append((key, c))
+    n = len(conts)
+    has_runs = any(c.type == ct.TYPE_RUN for _k, c in conts)
+    buf = io.BytesIO()
+    if has_runs:
+        buf.write(struct.pack("<I", OFFICIAL_COOKIE | ((n - 1) << 16)))
+        run_bitset = bytearray((n + 7) // 8)
+        for i, (_k, c) in enumerate(conts):
+            if c.type == ct.TYPE_RUN:
+                run_bitset[i >> 3] |= 1 << (i & 7)
+        buf.write(bytes(run_bitset))
+        has_offsets = n >= _OFFICIAL_NO_OFFSET_THRESHOLD
+    else:
+        buf.write(struct.pack("<II", OFFICIAL_COOKIE_NO_RUNS, n))
+        has_offsets = True
+    payloads = []
+    for _key, c in conts:
+        if c.type == ct.TYPE_RUN:
+            lengths = (c.data[:, 1] - c.data[:, 0]).astype(np.uint16)
+            pairs = np.stack([c.data[:, 0], lengths], axis=1).astype("<u2")
+            payloads.append(
+                struct.pack("<H", c.data.shape[0]) + pairs.tobytes()
+            )
+        else:
+            payloads.append(c.data.astype(c.data.dtype.newbyteorder("<")).tobytes())
+    for (key, c), payload in zip(conts, payloads):
+        buf.write(struct.pack("<HH", key, ct.container_count(c) - 1))
+    if has_offsets:
+        offset = buf.tell() + 4 * n
+        for payload in payloads:
+            buf.write(struct.pack("<I", offset))
+            offset += len(payload)
+    for payload in payloads:
+        buf.write(payload)
+    return buf.getvalue()
+
+
 def deserialize(data: bytes) -> tuple[Bitmap, int]:
     """Parse a snapshot; returns (bitmap, bytes consumed by the snapshot).
 

@@ -345,3 +345,50 @@ def test_official_format_through_import_roaring():
     frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
     frag.import_roaring(payload)
     assert frag.contains(0, 3) and frag.contains(0, 50) and not frag.contains(0, 5)
+
+
+def test_serialize_official_roundtrip(rng):
+    """serialize_official emits spec-conformant 12346/12347 payloads that
+    our official reader (and therefore stock clients) round-trip, across
+    array/bitmap/run container mixes and the n<4 no-offsets branch."""
+    cases = [
+        np.array([7], dtype=np.uint64),  # single array container, no runs
+        random_values(rng, 200, 1 << 20),  # multiple array containers
+        np.arange(100_000, 160_000, dtype=np.uint64),  # run container
+        np.concatenate(  # mixed: array + dense bitmap + run, ≥4 containers
+            [
+                random_values(rng, 100, 1 << 16),
+                (1 << 16) + random_values(rng, 9000, 1 << 16),
+                np.arange(1 << 17, (1 << 17) + 30_000, dtype=np.uint64),
+                np.array([(1 << 18) + 5], dtype=np.uint64),
+                np.array([(1 << 19) + 1, (1 << 19) + 2], dtype=np.uint64),
+            ]
+        ),
+    ]
+    for vals in cases:
+        b = roaring.Bitmap.from_values(vals)
+        data = roaring.serialize_official(b)
+        got, consumed = roaring.deserialize(data)
+        assert got == b, f"mismatch for {len(vals)} values"
+        assert consumed == len(data)
+
+
+def test_serialize_official_rejects_64bit_keys():
+    b = roaring.Bitmap.from_values(np.array([1 << 33], dtype=np.uint64))
+    with pytest.raises(ValueError, match="32-bit"):
+        roaring.serialize_official(b)
+
+
+def test_serialize_official_through_import_roaring():
+    """An official-format payload we produce imports into a fragment the
+    same way a stock client's would."""
+    from pilosa_tpu.core import Holder
+
+    h = Holder(None)
+    idx = h.create_index("iro")
+    f = idx.create_field("f")
+    vals = np.array([5, 9, (1 << 16) + 3], dtype=np.uint64)
+    payload = roaring.serialize_official(roaring.Bitmap.from_values(vals))
+    frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
+    frag.import_roaring(payload)
+    assert frag.contains(0, 5) and frag.contains(0, 9) and frag.contains(1, 3)
