@@ -319,14 +319,13 @@ class Executor:
         )
 
     def _sum_program(self, field: Field, n_shards: int):
-        return self.compiler.program(
-            ("sum", n_shards, field.bit_depth),
-            lambda: jax.jit(self._sum_fn),
+        return self.compiler.wrapped_program(
+            ("sum", n_shards, field.bit_depth), lambda: jax.jit(self._sum_fn)
         )
 
     def _grouped_sum_program(self, field: Field, n_shards: int):
         """(slices [D,S,W], masks [G,S,W]) → (pos[G,D], neg[G,D], n[G])."""
-        return self.compiler.program(
+        return self.compiler.wrapped_program(
             ("gb_sums", n_shards, field.bit_depth),
             lambda: jax.jit(jax.vmap(self._sum_fn, in_axes=(None, 0))),
         )
@@ -345,16 +344,20 @@ class Executor:
         field = self._agg_field(idx, call)
         slices = self._bsi_stacked(idx, field, shards)
         filt = self._filter_device(idx, call, shards)
-        prog = self.compiler.program(
-            ("minmax", len(shards), field.bit_depth, want_max),
-            lambda: jax.jit(
-                lambda s, f: jax.vmap(
-                    lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
-                    in_axes=(1, 0),
-                )(s, f)
-            ),
+        values, counts = (
+            np.asarray(x)
+            for x in self.compiler.run_program(
+                ("minmax", len(shards), field.bit_depth, want_max),
+                lambda: jax.jit(
+                    lambda s, f: jax.vmap(
+                        lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
+                        in_axes=(1, 0),
+                    )(s, f)
+                ),
+                slices,
+                filt,
+            )
         )
-        values, counts = (np.asarray(x) for x in prog(slices, filt))
         best, best_count = None, 0
         for v, n in zip(values.tolist(), counts.tolist()):
             if n == 0:
@@ -386,32 +389,39 @@ class Executor:
             return self._topn_finish(field, pairs, n, attr_name, attr_values)
         if ids is not None:
             row_ids = jnp.asarray(ids, jnp.int32)
-            prog = self.compiler.program(
-                ("topn_ids", len(shards)),
-                lambda: jax.jit(
-                    lambda m, r, f: jax.vmap(
-                        ops.topn.candidate_counts, in_axes=(1, None, 0)
-                    )(m, r, f)
-                    .astype(jnp.int64)
-                    .sum(axis=0)
-                ),
+            counts = np.asarray(
+                self.compiler.run_program(
+                    ("topn_ids", len(shards)),
+                    lambda: jax.jit(
+                        lambda m, r, f: jax.vmap(
+                            ops.topn.candidate_counts, in_axes=(1, None, 0)
+                        )(m, r, f)
+                        .astype(jnp.int64)
+                        .sum(axis=0)
+                    ),
+                    matrix,
+                    row_ids,
+                    filt,
+                )
             )
-            counts = np.asarray(prog(matrix, row_ids, filt))
             pairs = [
                 (int(r), int(c)) for r, c in zip(ids, counts.tolist()) if c > 0
             ]
         else:
-            prog = self.compiler.program(
-                ("topn", len(shards)),
-                lambda: jax.jit(
-                    lambda m, f: jax.vmap(ops.matrix_filter_counts, in_axes=(1, 0))(
-                        m, f
-                    )
-                    .astype(jnp.int64)
-                    .sum(axis=0)
-                ),
+            counts = np.asarray(
+                self.compiler.run_program(
+                    ("topn", len(shards)),
+                    lambda: jax.jit(
+                        lambda m, f: jax.vmap(
+                            ops.matrix_filter_counts, in_axes=(1, 0)
+                        )(m, f)
+                        .astype(jnp.int64)
+                        .sum(axis=0)
+                    ),
+                    matrix,
+                    filt,
+                )
             )
-            counts = np.asarray(prog(matrix, filt))
             nz = np.flatnonzero(counts)
             pairs = [(int(r), int(counts[r])) for r in nz.tolist()]
 
@@ -453,7 +463,7 @@ class Executor:
         stacks = self.compiler.stacks
         chunk = stacks.hot_capacity(len(shards))
         frags = [view.fragment(s) if view else None for s in shards]
-        prog = self.compiler.program(
+        prog = self.compiler.wrapped_program(
             ("topn_chunk", len(shards)),
             lambda: jax.jit(
                 # g [C,S,W] row-major chunk, f [S,W] → int64[C]
@@ -663,7 +673,9 @@ class Executor:
                 rows_arr = np.full(k_pad, -1, dtype=np.int32)
                 rows_arr[: len(rows_l)] = rows_l
                 return np.asarray(
-                    _gb_counts(masks, m, jnp.asarray(rows_arr))
+                    self.compiler.call_program(
+                        ("gb_counts",), _gb_counts, masks, m, jnp.asarray(rows_arr)
+                    )
                 )[:n_groups, : len(rows_l)]
             frags = _level_frags(level)
             hot = self.compiler.stacks.hot_capacity(n_shards)
@@ -674,7 +686,9 @@ class Executor:
                 host = _pack_rows(level, frags, sub, k_pad)
                 parts.append(
                     np.asarray(
-                        _gb_counts(
+                        self.compiler.call_program(
+                            ("gb_counts",),
+                            _gb_counts,
                             masks,
                             jnp.asarray(host),
                             jnp.arange(k_pad, dtype=jnp.int32),
@@ -706,7 +720,9 @@ class Executor:
                 row_sel[: chunk.shape[0]] = np.searchsorted(uniq_k, chunk[:, 1])
             else:
                 row_sel[: chunk.shape[0]] = [rows_l[k] for k in chunk[:, 1]]
-            return _gb_masks(masks, m, jnp.asarray(g_idx), jnp.asarray(row_sel))
+            return self.compiler.call_program(
+                ("gb_masks",), _gb_masks, masks, m, jnp.asarray(g_idx), jnp.asarray(row_sel)
+            )
 
         def expand(level: int, masks, groups: list[tuple]) -> None:
             if limit is not None and len(results) >= limit:

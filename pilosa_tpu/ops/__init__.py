@@ -10,9 +10,28 @@ on device (TPU emulates 64-bit integer ops; these are tiny scalar/[depth]
 tensors, so the cost is noise next to the popcount scans).
 """
 
+import os as _os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: query programs at pod scale take
+# minutes to compile (the gather program at 10k shards); caching them on
+# disk makes server restarts and repeat bench runs skip every compile.
+# An explicit JAX_COMPILATION_CACHE_DIR (or prior jax.config setting)
+# wins; PILOSA_TPU_NO_COMPILE_CACHE=1 opts out.
+if (
+    not _os.environ.get("JAX_COMPILATION_CACHE_DIR")
+    and _os.environ.get("PILOSA_TPU_NO_COMPILE_CACHE", "").lower()
+    not in ("1", "true", "yes")
+    and jax.config.jax_compilation_cache_dir is None
+):
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        _os.path.expanduser("~/.cache/pilosa_tpu/jax-cache"),
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 from pilosa_tpu.ops import bsi, similarity, topn
 from pilosa_tpu.ops.bitwise import (
