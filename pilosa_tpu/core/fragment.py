@@ -75,7 +75,7 @@ class Fragment:
         self.op_n = 0
         self.max_op_n = MAX_OP_N
         self._lock = threading.RLock()
-        self._file = None
+        self._opened = False  # gates ops-log appends (see _append_op)
 
         self._np_matrix: np.ndarray | None = None
         self._dirty_rows: set[int] = set()
@@ -118,20 +118,26 @@ class Fragment:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
                 if not os.path.exists(self.path):
                     self._write_snapshot()
-                self._file = open(self.path, "ab")
+            self._opened = True
             self._mark_all_dirty()
 
     def close(self) -> None:
-        with self._lock:
-            if self._file:
-                self._file.close()
-                self._file = None
+        pass  # no retained file handle (see _append_op)
 
     def _append_op(self, opcode: int, values: np.ndarray) -> None:
-        if self._file is None:
+        """Ops-log append, open-per-write. A retained append handle per
+        fragment exhausts the fd limit at scale: a time field with an
+        hourly quantum materializes a fragment per (bucket view, shard) —
+        one hourly-taxi import batch opened ~8.4k fragments, two batches
+        blew a 20k ulimit. An open/write/close per BATCH (the import path
+        is batched) is microseconds against the numpy work, and leaves
+        fds in use only while a write is in flight. Gated on open():
+        mutating a never-opened pathed fragment must stay in-memory-only
+        (appending to a file with no snapshot header would corrupt it)."""
+        if self.path is None or not self._opened:
             return
-        self._file.write(roaring.append_op(opcode, values))
-        self._file.flush()
+        with open(self.path, "ab") as f:
+            f.write(roaring.append_op(opcode, values))
         self.op_n += 1
         if self.op_n > self.max_op_n:
             self.snapshot()
@@ -143,10 +149,7 @@ class Fragment:
             if self.path is None:
                 self.op_n = 0
                 return
-            if self._file:
-                self._file.close()
             self._write_snapshot()
-            self._file = open(self.path, "ab")
             self.op_n = 0
 
     def _write_snapshot(self) -> None:

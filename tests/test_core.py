@@ -347,3 +347,37 @@ def test_attrstore_equal_ts_tie_break_converges(tmp_path):
     b.merge_block({7: {"city": ["ams", 0.0]}})
     assert a.attrs(7) == b.attrs(7) == {"city": "nyc"}  # "nyc" > "ams"
     assert a.block_checksums() == b.block_checksums()
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists("/proc/self/fd"),
+    reason="fd counting needs /proc (Linux)",
+)
+def test_many_fragments_hold_no_open_fds(tmp_path):
+    """A retained ops-log handle per fragment exhausts the process fd
+    limit at scale (a time field with an hourly quantum materializes
+    thousands of bucket-view fragments per import batch); appends must
+    open/write/close instead. Regression for the taxi-demo fd blowup."""
+    import os
+
+    def n_fds() -> int:
+        return len(os.listdir("/proc/self/fd"))
+
+    h = core.Holder(str(tmp_path / "data"))
+    f = h.create_index("fd").create_field(
+        "t",
+        core.FieldOptions(field_type=core.FIELD_TIME, time_quantum="YMDH"),
+    )
+    before = n_fds()
+    # 96 distinct hour buckets → Y+YM+YMD+YMDH views, each with a
+    # durable fragment file on disk
+    ts = [datetime(2024, 1, 1 + d, hour) for d in range(4) for hour in range(24)]
+    f.import_bulk(
+        np.zeros(len(ts), dtype=np.uint64),
+        np.arange(len(ts), dtype=np.uint64),
+        timestamps=ts,
+    )
+    n_frags = sum(len(v.fragments) for v in f.views.values())
+    assert n_frags > 100  # the scenario is real: one batch, many fragments
+    assert n_fds() <= before + 4, "fragment files must not stay open"
+    h.close()
