@@ -104,6 +104,16 @@ class ExecutionError(ValueError):
     pass
 
 
+class _PendingCount:
+    """An unsynced on-device Count scalar; execute() resolves every
+    pending count with one readback wave after all calls dispatched."""
+
+    __slots__ = ("value",)
+
+    def __init__(self, value):
+        self.value = value
+
+
 @jax.jit
 def _gb_counts(masks, matrix, rows):
     """GroupBy level counts: [G,S,W] masks × K candidate rows (gathered
@@ -157,7 +167,23 @@ class Executor:
         if idx is None:
             raise ExecutionError(f"index {index_name!r} not found")
         calls = parse(query) if isinstance(query, str) else query
-        return [self._execute_call(idx, c, shards) for c in calls]
+        # Count calls dispatch ASYNC (a device scalar, not yet synced) and
+        # resolve together after every call has dispatched: an N-count
+        # request pays one device→host round trip instead of N. Dispatch
+        # order is program order, so counts preceding a write still read
+        # pre-write state — exactly the sequential semantics.
+        results = [self._execute_call(idx, c, shards, lazy=True) for c in calls]
+        pending = [r for r in results if isinstance(r, _PendingCount)]
+        if len(pending) > 1:
+            # ONE transfer for the whole wave: stacking the device scalars
+            # is a single tiny dispatch, and the np.asarray fetches them
+            # in one round trip (per-int() fetches are an RTT each)
+            fetched = np.asarray(jnp.stack([p.value for p in pending]))
+            for p, v in zip(pending, fetched.tolist()):
+                p.value = int(v)
+        return [
+            int(r.value) if isinstance(r, _PendingCount) else r for r in results
+        ]
 
     def _shards(self, idx: Index, shards: list[int] | None) -> list[int]:
         if shards is not None:
@@ -165,13 +191,17 @@ class Executor:
         avail = idx.available_shards()
         return sorted(avail) if avail else [0]
 
-    def _execute_call(self, idx: Index, call: Call, shards: list[int] | None) -> Any:
+    def _execute_call(
+        self, idx: Index, call: Call, shards: list[int] | None, lazy: bool = False
+    ) -> Any:
         name = call.name
         if name == "Options":
             if len(call.children) != 1:
                 raise ExecutionError("Options() takes exactly one call")
             opt_shards = call.arg("shards", shards)
-            res = self._execute_call(idx, call.children[0], opt_shards)
+            res = self._execute_call(idx, call.children[0], opt_shards, lazy=lazy)
+            if isinstance(res, _PendingCount):
+                return res  # Options() has no shaping args for a scalar
             return apply_options(idx, call, res)
         if name in WRITE_CALLS:
             return self._execute_write(idx, call)
@@ -188,6 +218,10 @@ class Executor:
             if name == "Count":
                 if len(call.children) != 1:
                     raise ExecutionError("Count() takes exactly one call")
+                if lazy:
+                    return _PendingCount(
+                        self.compiler.count_async(idx, call.children[0], shard_list)
+                    )
                 return self.compiler.count(idx, call.children[0], shard_list)
             if name == "Sum":
                 return self._execute_sum(idx, call, shard_list)

@@ -370,3 +370,17 @@ def test_index_recreate_does_not_serve_stale_cache():
     e.execute("i", "Set(2, f=0)")
     (r,) = e.execute("i", "Row(f=0)")
     assert r.columns().tolist() == [2]
+
+
+def test_multi_count_single_request_order_semantics(env):
+    """Counts in a multi-call request dispatch async and resolve together;
+    a count BEFORE a write must still read pre-write state (program-order
+    semantics), and one after it the post-write state."""
+    h, idx, e = env
+    idx.create_field("f")
+    q(e, "Set(1, f=30) Set(2, f=30)")
+    res = q(
+        e,
+        'Count(Row(f=30)) Set(3, f=30) Count(Row(f=30)) Options(Count(Row(f=30)))',
+    )
+    assert res == [2, True, 3, 3]
