@@ -278,6 +278,32 @@ class API:
         return datetime.fromisoformat(t)
 
     # ------------------------------------------------------------- export
+    def fragment_data(
+        self,
+        index: str,
+        field: str,
+        shard: int,
+        view: str = VIEW_STANDARD,
+        fmt: str = "pilosa",
+    ) -> bytes:
+        """One fragment's bitmap, serialized. ``fmt``: "pilosa" (the
+        cookie-12348 fragment file layout) or "official" (32-bit
+        RoaringFormatSpec — what stock CRoaring/RoaringBitmap clients
+        parse; only representable when every row id < 2^32/SHARD_WIDTH,
+        since the interchange format is 32-bit)."""
+        from pilosa_tpu import roaring
+
+        if fmt not in ("pilosa", "official"):
+            raise ExecutionError(f"unknown roaring format {fmt!r}")
+        idx = self._index(index)
+        f = self._field(idx, field)
+        v = f.view(view)
+        frag = v.fragment(shard) if v is not None else None
+        bm = frag.bitmap if frag is not None else roaring.Bitmap()
+        if fmt == "official":
+            return roaring.serialize_official(bm)
+        return roaring.serialize(bm)
+
     def export_csv(self, index: str, field: str, shard: int | None = None) -> str:
         """CSV rows of (rowID/key, columnID/key) pairs (reference:
         api.ExportCSV)."""

@@ -15,6 +15,7 @@ field names match the reference so existing clients port over:
     GET    /schema        POST /schema
     GET    /status  /info  /version  /metrics  /debug/vars  /debug/traces
     GET    /export?index=i&field=f
+    GET    /index/{index}/field/{field}/fragment/data?shard=N[&format=pilosa|official]
     GET    /internal/fragment/nodes?index=i&shard=3
     (further /internal/* data-plane routes live in the cluster layer)
 """
@@ -61,6 +62,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/pprof/goroutine$"), "pprof_goroutine"),
     ("GET", re.compile(r"^/debug/pprof/heap$"), "pprof_heap"),
     ("GET", re.compile(r"^/export$"), "export"),
+    (
+        "GET",
+        re.compile(r"^/index/([^/]+)/field/([^/]+)/fragment/data$"),
+        "fragment_export",
+    ),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
 ]
 
@@ -169,7 +175,11 @@ class Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def _text(self, text: str, content_type: str = "text/plain", code: int = 200) -> None:
-        data = text.encode()
+        self._bytes(text.encode(), content_type=content_type, code=code)
+
+    def _bytes(
+        self, data: bytes, content_type: str = "application/octet-stream", code: int = 200
+    ) -> None:
         self.send_response(code)
         self.send_header("Content-Type", content_type)
         self.send_header("Content-Length", str(len(data)))
@@ -379,6 +389,17 @@ class Handler(BaseHTTPRequestHandler):
         shard = self.query_params.get("shard", [None])[0]
         csv = self.api.export_csv(index, field, int(shard) if shard else None)
         self._text(csv, content_type="text/csv")
+
+    def h_fragment_export(self, index: str, field: str) -> None:
+        """Serialized fragment bitmap; ?format=pilosa|official selects the
+        cookie-12348 fragment layout or the stock-client 32-bit
+        RoaringFormatSpec (reference analogue: RetrieveShardFromURI, made
+        public so stock roaring tooling can pull fragments)."""
+        shard = self.query_params.get("shard", ["0"])[0]
+        view = self.query_params.get("view", ["standard"])[0]
+        fmt = self.query_params.get("format", ["pilosa"])[0]
+        data = self.api.fragment_data(index, field, int(shard), view, fmt)
+        self._bytes(data, content_type="application/octet-stream")
 
     def h_fragment_nodes(self) -> None:
         index = self.query_params.get("index", [None])[0]

@@ -201,3 +201,33 @@ def test_max_writes_per_request_enforced(tmp_path):
         assert 4 not in r["results"][0]["columns"]
     finally:
         s.close()
+
+
+def test_fragment_export_formats(srv):
+    """GET …/fragment/data serves the fragment bitmap in the pilosa
+    layout or (format=official) the stock 32-bit RoaringFormatSpec;
+    both round-trip through the roaring reader."""
+    import numpy as np
+
+    from pilosa_tpu import roaring
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    call(srv, "POST", "/index/fx", {})
+    call(srv, "POST", "/index/fx/field/f", {})
+    call(srv, "POST", "/index/fx/query", b"Set(1, f=0) Set(9, f=0) Set(5, f=2)")
+    import struct
+
+    for fmt, cookies in (("pilosa", {12348}), ("official", {12346, 12347})):
+        raw = call(
+            srv, "GET", f"/index/fx/field/f/fragment/data?shard=0&format={fmt}",
+            raw=True,
+        )
+        assert struct.unpack_from("<H", raw)[0] in cookies  # wire layout
+        b, consumed = roaring.deserialize(raw)
+        assert consumed == len(raw)
+        want = {1, 9, 2 * SHARD_WIDTH + 5}
+        assert set(b.values().tolist()) == want
+    # empty shard serves an empty bitmap, still parseable
+    raw = call(srv, "GET", "/index/fx/field/f/fragment/data?shard=7", raw=True)
+    b, _ = roaring.deserialize(raw)
+    assert b.count() == 0
