@@ -767,8 +767,8 @@ class QueryCompiler:
 
     @staticmethod
     def _abstract(x):
-        if not hasattr(x, "dtype"):
-            return x
+        if not isinstance(x, (np.ndarray, jax.Array)):
+            return x  # static scalars (incl. numpy scalars) pass through
         sh = getattr(x, "sharding", None)
         if sh is not None and not isinstance(sh, jax.sharding.NamedSharding):
             # single-device arrays lower WITHOUT a sharding annotation:
@@ -798,7 +798,7 @@ class QueryCompiler:
         sig = key + tuple(
             (np.shape(x), x.dtype, getattr(x, "sharding", None))
             for x in jax.tree_util.tree_leaves(args)
-            if hasattr(x, "dtype")
+            if isinstance(x, (np.ndarray, jax.Array))
         )
         if sig not in self._aot:
             shapes = jax.tree_util.tree_map(self._abstract, args)

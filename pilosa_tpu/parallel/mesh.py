@@ -171,6 +171,37 @@ class MeshQueryEngine:
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
+        self._aot: set[tuple] = set()
+
+    def _call(self, name: str, prog, *args):
+        """Explicit AOT compile per (program, shapes) before the first
+        call — jit's lazy compile-on-call path is pathologically slow on
+        remote/tunneled accelerators and skips the persistent compile
+        cache (see executor.compile.QueryCompiler.call_program, where
+        this was measured: the subsequent concrete prog() call reuses
+        the AOT-compiled executable rather than recompiling — measured
+        ~0 s after a sub-second lower().compile() for a program whose
+        lazy path took a minute). Static trailing args (e.g. top-k's k,
+        a plain int or numpy scalar — NOT an ndarray) pass through to
+        lower() as-is."""
+        shapes = tuple(
+            jax.ShapeDtypeStruct(
+                x.shape, x.dtype, sharding=getattr(x, "sharding", None)
+            )
+            if isinstance(x, (np.ndarray, jax.Array))
+            else x
+            for x in args
+        )
+        sig = (name,) + tuple(
+            (s.shape, s.dtype, s.sharding)
+            if isinstance(s, jax.ShapeDtypeStruct)
+            else s
+            for s in shapes
+        )
+        if sig not in self._aot:
+            prog.lower(*shapes).compile()
+            self._aot.add(sig)
+        return prog(*args)
 
     # ------------------------------------------------------------ placement
     def spec_matrix(self) -> NamedSharding:
@@ -188,8 +219,22 @@ class MeshQueryEngine:
         return jax.device_put(stacked, self.spec_row())
 
     # ------------------------------------------------------------- programs
+    def count_and(self, a, b):
+        return self._call("count_and", self._count_and_prog, a, b)
+
+    def topn(self, matrix, filt, k: int):
+        return self._call("topn", self._topn_prog, matrix, filt, k)
+
+    def bsi_sum(self, slices, filt):
+        return self._call("bsi_sum", self._bsi_sum_prog, slices, filt)
+
+    def ingest_and_aggregate(self, matrix, delta, filt):
+        return self._call(
+            "ingest_and_aggregate", self._ingest_prog, matrix, delta, filt
+        )
+
     @functools.cached_property
-    def count_and(self):
+    def _count_and_prog(self):
         @jax.jit
         @functools.partial(
             shard_map,
@@ -204,7 +249,7 @@ class MeshQueryEngine:
         return prog
 
     @functools.cached_property
-    def topn(self):
+    def _topn_prog(self):
         """(matrix [R,S,W], filt [S,W]) → per-row global counts int64[R]
         (psum over both axes; top_k happens on the replicated vector)."""
 
@@ -230,7 +275,7 @@ class MeshQueryEngine:
         return prog
 
     @functools.cached_property
-    def bsi_sum(self):
+    def _bsi_sum_prog(self):
         """(slices [D,S,W], filt [S,W]) → (sum int64, count int64)."""
 
         @jax.jit
@@ -259,7 +304,7 @@ class MeshQueryEngine:
         return prog
 
     @functools.cached_property
-    def ingest_and_aggregate(self):
+    def _ingest_prog(self):
         """The full "step": apply a packed write delta to the row matrix
         (device-side ingest, the donated-buffer mutation path) then compute
         the standing aggregates — one compiled program, zero host round
