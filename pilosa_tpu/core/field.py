@@ -235,12 +235,28 @@ class Field:
             self._bit_depth = needed
             self.save_meta()
 
+    def _check_range(self, lo: int, hi: int) -> None:
+        """Reject values outside the declared [min, max] (reference:
+        field.go importValue "value out of range"). Fields created with
+        the default min = max = 0 are unbounded — depth grows with the
+        data instead."""
+        o = self.options
+        if o.min == 0 and o.max == 0:
+            return
+        if lo < o.min or hi > o.max:
+            bad = lo if lo < o.min else hi
+            raise ValueError(
+                f"field {self.name!r}: value {bad} out of range "
+                f"[{o.min}, {o.max}]"
+            )
+
     def set_value(self, col: int, value: int) -> bool:
         """Store an integer (sign-magnitude BSI write). Overwrites any
         existing value for the column."""
         if self.options.field_type != FIELD_INT:
             raise ValueError(f"field {self.name!r} is not an int field")
         value = int(value)
+        self._check_range(value, value)
         self._grow_depth(abs(value).bit_length())
         shard = col // SHARD_WIDTH
         frag = self.create_view_if_not_exists(VIEW_BSI).create_fragment_if_not_exists(shard)
@@ -369,6 +385,7 @@ class Field:
         values = np.asarray(values, dtype=np.int64)
         if cols.size == 0:
             return
+        self._check_range(int(values.min()), int(values.max()))
         self._grow_depth(int(np.abs(values).max()).bit_length())
         shards = cols // np.uint64(SHARD_WIDTH)
         for shard in np.unique(shards).tolist():

@@ -661,6 +661,14 @@ class Cluster:
         # split — per-node slices passing their own check must not let an
         # oversized request through piecemeal
         api.check_write_limit(api._payload_size(payload), "import")
+        if values and not payload.get("clear") and payload.get("values"):
+            # whole-request range check BEFORE the fan-out: per-shard
+            # sub-batches validate independently, so one out-of-range
+            # value mid-request would otherwise leave the earlier shards'
+            # writes committed behind a "rejected" error
+            f = api._field(api._index(index), field)
+            vals = payload["values"]
+            f._check_range(int(min(vals)), int(max(vals)))
         # cluster-consistent key translation through the primary
         if payload.get("columnKeys"):
             payload = dict(payload)

@@ -700,3 +700,32 @@ def test_dead_sole_owner_errors_not_partial(tmp_path):
         assert e.value.code == 503
     finally:
         shutdown(servers)
+
+
+def test_out_of_range_import_value_rejected_before_fanout(tmp_path):
+    """A clustered import-value with one out-of-range value must reject
+    the WHOLE request before any shard's sub-batch commits — per-shard
+    validation after the split would leave a partial import behind a
+    'rejected' error."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(
+            ports[0], "POST", "/index/i/field/v",
+            {"options": {"type": "int", "min": 0, "max": 100}},
+        )
+        cols = [1, SHARD_WIDTH + 1]  # two shards; shard of col 1 goes first
+        vals = [50, 200]  # second shard's value is out of range
+        with pytest.raises(urllib.error.HTTPError) as err:
+            call(
+                ports[0], "POST", "/index/i/field/v/import-value",
+                {"columnIDs": cols, "values": vals},
+            )
+        assert err.value.code == 400
+        # nothing committed anywhere: the in-range first-shard value too
+        r = call(ports[0], "POST", "/index/i/query", b"Sum(field=v)")
+        assert r["results"][0] == {"value": 0, "count": 0}
+    finally:
+        for s in servers:
+            if s is not None:
+                s.close()

@@ -143,8 +143,9 @@ def test_int_field_value_roundtrip():
     assert f.value(99) == (0, False)
     f.set_value(1, -5)  # overwrite flips sign and magnitude
     assert f.value(1) == (-5, True)
-    f.set_value(2, 123456789)  # grow depth beyond min/max hint
-    assert f.value(2) == (123456789, True)
+    with pytest.raises(ValueError, match="out of range"):
+        f.set_value(2, 123456789)  # beyond declared max (reference:
+        # field.go importValue value-out-of-range)
     assert f.value(SHARD_WIDTH + 5) == (999, True)
     f.clear_value(3)
     assert f.value(3) == (0, False)
@@ -381,3 +382,26 @@ def test_many_fragments_hold_no_open_fds(tmp_path):
     assert n_frags > 100  # the scenario is real: one batch, many fragments
     assert n_fds() <= before + 4, "fragment files must not stay open"
     h.close()
+
+
+def test_int_field_value_range_enforced():
+    """Values outside a declared [min, max] are rejected (reference:
+    field.go importValue "value out of range"); default min=max=0 fields
+    stay unbounded and grow their bit depth with the data."""
+    h = core.Holder(None)
+    idx = h.create_index("rng")
+    bounded = idx.create_field(
+        "b", core.FieldOptions(field_type=core.FIELD_INT, min=-10, max=100)
+    )
+    bounded.set_value(5, 100)
+    bounded.set_value(6, -10)
+    with pytest.raises(ValueError, match="out of range"):
+        bounded.set_value(7, 101)
+    with pytest.raises(ValueError, match="out of range"):
+        bounded.import_values(
+            np.array([1, 2], dtype=np.uint64), np.array([50, -11], dtype=np.int64)
+        )
+    # unbounded default: grows depth instead of raising
+    free = idx.create_field("u", core.FieldOptions(field_type=core.FIELD_INT))
+    free.set_value(1, 10**12)
+    assert free.value(1) == (10**12, True)
