@@ -99,6 +99,7 @@ class Cluster:
         self._mount_internal_routes()
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
+        self.server.http.translate_router = self._route_translate_keys
         self.server.http.broadcast_schema = self.broadcast_schema
         self.server.http.broadcast_deletion = self.broadcast_deletion
 
@@ -721,6 +722,50 @@ class Cluster:
                 )
 
     # ---------------------------------------------------------- translation
+    def _route_translate_keys(
+        self, index: str, field: str | None, keys: list[str], create: bool
+    ) -> list[int | None]:
+        """Cluster-safe /internal/translate/keys: ID allocation happens
+        ONLY on the translate primary — a non-primary node allocating
+        from its local counter would hand out IDs the primary also hands
+        out for different keys, forking the key space. Non-primary nodes
+        forward and cache the primary's entries locally (same discipline
+        as _col_key_lookup)."""
+        self._check_ready()  # 503 while STARTING — a stale local counter
+        # allocating here is exactly the key-space fork this router exists
+        # to prevent
+        api = self.server.api
+        store = api._translate_store(index, field)  # validates keys option
+        primary = self._translate_primary()
+        if primary.id == self.me.id:
+            return api.translate_keys(index, field, keys, create=create)
+        if create:
+            api.check_write_limit(len(keys), "translate")
+        # local-cache-first (same discipline as _col_key_lookup): entries
+        # tailed from the primary serve hits without a round trip; only
+        # misses travel
+        local = store.translate_keys(keys, create=False)
+        miss = [k for k, i in zip(keys, local) if i is None]
+        if miss:
+            payload: dict = {"index": index, "keys": miss, "create": create}
+            if field:
+                payload["field"] = field
+            try:
+                got = self.client._json(
+                    "POST", primary.uri, "/internal/translate/create", payload
+                )["ids"]
+            except PeerError as e:
+                raise ShardUnavailableError(
+                    f"translate primary unavailable: {e}"
+                ) from e
+            store.apply_entries([(k, i) for k, i in zip(miss, got) if i])
+            by_key = dict(zip(miss, got))
+            local = [
+                i if i is not None else by_key.get(k)
+                for k, i in zip(keys, local)
+            ]
+        return local
+
     def _translate_primary(self) -> Node:
         """The sorted-first alive node owns key allocation (reference:
         translate.go primary/replica design)."""

@@ -277,6 +277,34 @@ class API:
             return datetime.utcfromtimestamp(t)
         return datetime.fromisoformat(t)
 
+    # -------------------------------------------------------- translation
+    def _translate_store(self, index: str, field: str | None):
+        """The keyed index's column store or keyed field's row store;
+        validates the keys option (shared by the local path and the
+        cluster's primary-forwarding router)."""
+        idx = self._index(index)
+        if field:
+            f = self._field(idx, field)
+            if not f.options.keys:
+                raise ExecutionError(f"field {field!r} does not use string keys")
+            return f.row_keys
+        if not idx.options.keys:
+            raise ExecutionError(f"index {index!r} does not use string keys")
+        return idx.column_keys
+
+    def translate_keys(
+        self, index: str, field: str | None, keys: list[str], create: bool = True
+    ) -> list[int | None]:
+        """String keys → IDs for a keyed index (column keys) or field
+        (row keys). ``create=False`` (lookup-only) leaves unknown keys as
+        None — the wire layer maps them to 0, IDs start at 1. Creation is
+        a write: the max_writes_per_request limit applies. Reference:
+        api.TranslateKeys via POST /internal/translate/keys."""
+        store = self._translate_store(index, field)
+        if create:
+            self.check_write_limit(len(keys), "translate")
+        return store.translate_keys(keys, create=create)
+
     # ------------------------------------------------------------- export
     def fragment_data(
         self,

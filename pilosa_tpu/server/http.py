@@ -17,6 +17,7 @@ field names match the reference so existing clients port over:
     GET    /export?index=i&field=f
     GET    /index/{index}/field/{field}/fragment/data?shard=N[&format=pilosa|official]
     GET    /internal/fragment/nodes?index=i&shard=3
+    POST   /internal/translate/keys     (JSON or protobuf TranslateKeysRequest)
     (further /internal/* data-plane routes live in the cluster layer)
 """
 
@@ -68,6 +69,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
         "fragment_export",
     ),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
+    ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
 ]
 
 
@@ -131,8 +133,9 @@ class Handler(BaseHTTPRequestHandler):
         """Error response in the negotiated wire format (reference:
         handler errors land in QueryResponse.err / ImportResponse.err for
         protobuf clients, plain JSON otherwise). Only the query and
-        import routes negotiate protobuf; every other route is JSON on
-        success, so its errors stay JSON too."""
+        import routes carry an err field in their protobuf responses;
+        every other route's errors are JSON regardless of negotiation
+        (e.g. translate_keys — TranslateKeysResponse has no err field)."""
         if self._wants_proto() and self.route_name.startswith("import"):
             self._proto(encoding.protoser.import_response_to_bytes(msg), code=code)
         elif self._wants_proto() and self.route_name == "query":
@@ -401,6 +404,32 @@ class Handler(BaseHTTPRequestHandler):
         data = self.api.fragment_data(index, field, int(shard), view, fmt)
         self._bytes(data, content_type="application/octet-stream")
 
+    def h_translate_keys(self) -> None:
+        """String keys → IDs (reference: POST /internal/translate/keys).
+        Accepts a protobuf TranslateKeysRequest or JSON
+        {"index", "field"?, "keys", "lookupOnly"?}; replies in kind
+        (errors are always JSON — TranslateKeysResponse has no err
+        field). Unknown keys on a lookup-only request come back as 0.
+        Goes through the server's translate_router so the cluster layer
+        can forward ID allocation to the translate primary."""
+        if self._proto_body():
+            req = encoding.protoser.translate_keys_request_from_bytes(self._body())
+        else:
+            j = self._json_body()
+            req = {
+                "index": j.get("index", ""),
+                "field": j.get("field", ""),
+                "keys": j.get("keys", []),
+                "create": not j.get("lookupOnly", False),
+            }
+        ids = self.server.translate_router(
+            req["index"], req["field"] or None, req["keys"], req["create"]
+        )
+        if self._wants_proto():
+            self._proto(encoding.protoser.translate_keys_response_to_bytes(ids))
+        else:
+            self._json({"ids": [i or 0 for i in ids]})
+
     def h_fragment_nodes(self) -> None:
         index = self.query_params.get("index", [None])[0]
         shard = self.query_params.get("shard", ["0"])[0]
@@ -429,6 +458,13 @@ class HTTPServer(ThreadingHTTPServer):
         self.extra_routes: dict = {}
         self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
         self.import_router = self._local_import
+        # cluster layer swaps this for a primary-forwarding version — ID
+        # allocation on a non-primary node would fork the key space
+        self.translate_router = (
+            lambda index, field, keys, create: api.translate_keys(
+                index, field, keys, create=create
+            )
+        )
         self.broadcast_schema = lambda: None
         self.broadcast_deletion = lambda index, field=None: None
 

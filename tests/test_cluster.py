@@ -729,3 +729,27 @@ def test_out_of_range_import_value_rejected_before_fanout(tmp_path):
         for s in servers:
             if s is not None:
                 s.close()
+
+
+def test_translate_keys_allocates_on_primary_only(tmp_path):
+    """POST /internal/translate/keys against a NON-primary node must
+    forward allocation to the translate primary — local allocation would
+    fork the key space (two keys sharing one ID after the primary's tail
+    overwrites). Both nodes must agree on every mapping."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/ki", {"options": {"keys": True}})
+        # allocate via node 1 and node 0 alternately
+        a = call(ports[1], "POST", "/internal/translate/keys",
+                 {"index": "ki", "keys": ["k1", "k2"]})["ids"]
+        b = call(ports[0], "POST", "/internal/translate/keys",
+                 {"index": "ki", "keys": ["k3", "k1"]})["ids"]
+        assert len(set(a + b[:1])) == 3  # three distinct ids
+        assert b[1] == a[0]  # k1 resolves identically on both nodes
+        c = call(ports[1], "POST", "/internal/translate/keys",
+                 {"index": "ki", "keys": ["k3"], "lookupOnly": True})["ids"]
+        assert c == [b[0]]
+    finally:
+        for s in servers:
+            if s is not None:
+                s.close()

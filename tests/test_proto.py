@@ -322,3 +322,44 @@ def test_http_import_error_is_proto_encoded(srv):
     assert code == 400
     assert ctype == protoser.CONTENT_TYPE
     assert protoser.import_response_from_bytes(raw) != ""
+
+
+def test_translate_keys_endpoint_json_and_proto(srv):
+    """POST /internal/translate/keys — JSON and protobuf in/out
+    (reference: api.TranslateKeys). Lookup-only maps unknown keys to 0."""
+    _call(srv, "/index/ki", json.dumps({"options": {"keys": True}}).encode())
+    _call(srv, "/index/ki/field/kf", json.dumps({"options": {"keys": True}}).encode())
+    # JSON path: create column keys on the index
+    raw, _ = _call(
+        srv,
+        "/internal/translate/keys",
+        json.dumps({"index": "ki", "keys": ["a", "b", "a"]}).encode(),
+    )
+    out = json.loads(raw)
+    assert out["ids"][0] == out["ids"][2] != out["ids"][1]
+    assert all(i > 0 for i in out["ids"])
+    # protobuf path: row keys on the field, lookup-only misses → 0
+    proto_hdrs = {
+        "Content-Type": "application/x-protobuf",
+        "Accept": "application/x-protobuf",
+    }
+    body = protoser.translate_keys_request_to_bytes(
+        "ki", ["x", "y"], field="kf", create=True
+    )
+    raw, ctype = _call(srv, "/internal/translate/keys", body, proto_hdrs)
+    assert "protobuf" in ctype
+    ids = protoser.translate_keys_response_from_bytes(raw)
+    assert len(ids) == 2 and all(i > 0 for i in ids)
+    body = protoser.translate_keys_request_to_bytes(
+        "ki", ["x", "zzz"], field="kf", create=False
+    )
+    raw, _ = _call(srv, "/internal/translate/keys", body, proto_hdrs)
+    assert protoser.translate_keys_response_from_bytes(raw) == [ids[0], 0]
+    # non-keyed index → JSON error even for protobuf clients
+    body = protoser.translate_keys_request_to_bytes("nope", ["k"])
+    _call(srv, "/index/nope", json.dumps({}).encode())
+    import pytest as _pytest
+
+    with _pytest.raises(urllib.error.HTTPError) as err:
+        _call(srv, "/internal/translate/keys", body, proto_hdrs)
+    assert err.value.code == 400
