@@ -392,3 +392,28 @@ def test_serialize_official_through_import_roaring():
     frag = f.create_view_if_not_exists("standard").create_fragment_if_not_exists(0)
     frag.import_roaring(payload)
     assert frag.contains(0, 5) and frag.contains(0, 9) and frag.contains(1, 3)
+
+
+def test_serialize_official_fuzz_roundtrip(rng):
+    """Randomized container mixes through the official writer/reader:
+    densities crossing the array/bitmap threshold, runs, container-count
+    edges around the no-offsets branch (n < 4), single containers."""
+    for trial in range(30):
+        parts = []
+        n_containers = int(rng.integers(1, 8))
+        for c in range(n_containers):
+            base = c << 16
+            kind = int(rng.integers(0, 3))
+            if kind == 0:  # sparse array
+                parts.append(base + rng.choice(1 << 16, int(rng.integers(1, 200)), replace=False))
+            elif kind == 1:  # dense bitmap
+                parts.append(base + rng.choice(1 << 16, int(rng.integers(5000, 9000)), replace=False))
+            else:  # run
+                start = int(rng.integers(0, 30000))
+                parts.append(base + np.arange(start, start + int(rng.integers(4200, 20000))))
+        vals = np.unique(np.concatenate(parts).astype(np.uint64))
+        b = roaring.Bitmap.from_values(vals)
+        data = roaring.serialize_official(b)
+        got, consumed = roaring.deserialize(data)
+        assert consumed == len(data), f"trial {trial}: trailing bytes"
+        assert got == b, f"trial {trial}: contents diverged"
