@@ -297,6 +297,10 @@ def transport_context():
     # median, matching bench.py's transport_rtt_ms so the two artifacts'
     # floors are directly comparable
     line("transport_sync_rtt_ms", sorted(lats)[len(lats) // 2] * 1e3, "ms", 1.0)
+    # the CPU-side numbers (baselines, ingest Mbit/s) are bounded by host
+    # cores — print them so a 1-core CI box's figures aren't read as the
+    # framework's ceiling
+    line("host_cpus", float(os.cpu_count() or 1), "cores", 1.0)
 
 
 def main():
