@@ -131,4 +131,52 @@ int64_t u64_difference(const uint64_t* a, int64_t na, const uint64_t* b,
     return k;
 }
 
+
+// --------------------------------------------------- sorting primitives
+// LSD radix sort (8 passes x 8 bits) + in-place dedupe. ``tmp`` must hold
+// n elements; the sorted-unique result lands in ``data``; returns the
+// unique count. Passes whose byte is constant across the input are
+// skipped (common: values sharing high bytes), with a final copy if the
+// live buffer ends up in tmp.
+int64_t u64_sort_unique(uint64_t* data, int64_t n, uint64_t* tmp) {
+    if (n <= 0) return 0;
+    uint64_t* src = data;
+    uint64_t* dst = tmp;
+    for (int pass = 0; pass < 8; ++pass) {
+        const int shift = pass * 8;
+        int64_t hist[256] = {0};
+        for (int64_t i = 0; i < n; ++i) ++hist[(src[i] >> shift) & 0xFF];
+        int nonzero = 0;
+        for (int b = 0; b < 256 && nonzero < 2; ++b) nonzero += hist[b] != 0;
+        if (nonzero < 2) continue;  // constant byte: order unchanged
+        int64_t offs[256];
+        int64_t acc = 0;
+        for (int b = 0; b < 256; ++b) { offs[b] = acc; acc += hist[b]; }
+        for (int64_t i = 0; i < n; ++i)
+            dst[offs[(src[i] >> shift) & 0xFF]++] = src[i];
+        uint64_t* t = src; src = dst; dst = t;
+    }
+    // dedupe while (if needed) moving back into data
+    int64_t k = 0;
+    for (int64_t i = 0; i < n; ++i) {
+        if (i == 0 || src[i] != src[i - 1]) data[k++] = src[i];
+    }
+    return k;
+}
+
+// Stable counting argsort for small integer keys (max_key bounded):
+// O(n + max_key). ``counts`` must hold max_key + 1 zeroed slots.
+void u64_counting_argsort(const uint64_t* keys, int64_t n, int64_t max_key,
+                          int64_t* counts, int64_t* order) {
+    for (int64_t i = 0; i < n; ++i) ++counts[keys[i]];
+    int64_t acc = 0;
+    for (int64_t b = 0; b <= max_key; ++b) {
+        int64_t c = counts[b];
+        counts[b] = acc;
+        acc += c;
+    }
+    for (int64_t i = 0; i < n; ++i) order[counts[keys[i]]++] = i;
+}
+
 }  // extern "C"
+

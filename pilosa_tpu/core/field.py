@@ -32,11 +32,16 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 def _shard_slices(cols: np.ndarray):
     """Yield (shard, index_array) per touched shard via one stable
-    argsort — per-shard boolean masks are O(n × shards) and dominate
-    imports that span many shards."""
+    grouping pass — per-shard boolean masks are O(n × shards) and
+    dominate imports that span many shards. Shard ids are small ints,
+    so the native counting argsort (O(n + shards)) replaces the
+    comparison sort when available."""
+    from pilosa_tpu import native
+
     shards = cols // np.uint64(SHARD_WIDTH)
-    order = np.argsort(shards, kind="stable")
-    uniq, starts = np.unique(shards[order], return_index=True)
+    max_shard = int(shards.max()) if shards.size else 0
+    order = native.counting_argsort(shards, max_shard)
+    uniq, starts = native.uniq_sorted(shards[order])
     bounds = np.append(starts, order.size)
     for i, shard in enumerate(uniq.tolist()):
         yield int(shard), order[bounds[i] : bounds[i + 1]]

@@ -78,6 +78,12 @@ def _load() -> ctypes.CDLL | None:
             fn = getattr(lib, name)
             fn.argtypes = [c_u64p, ctypes.c_int64, c_u64p, ctypes.c_int64, c_u64p]
             fn.restype = ctypes.c_int64
+        lib.u64_sort_unique.argtypes = [c_u64p, ctypes.c_int64, c_u64p]
+        lib.u64_sort_unique.restype = ctypes.c_int64
+        lib.u64_counting_argsort.argtypes = [
+            c_u64p, ctypes.c_int64, ctypes.c_int64, c_i64p, c_i64p,
+        ]
+        lib.u64_counting_argsort.restype = None
         _lib = lib
         AVAILABLE = True
         return lib
@@ -147,6 +153,61 @@ def unpack_words(words: np.ndarray) -> np.ndarray:
     out = np.empty(int(words_count(w)), dtype=np.int64)
     n = lib.unpack_words(_ptr(w, ctypes.c_uint32), w.size, _ptr(out, ctypes.c_int64))
     return out[:n]
+
+
+def sort_unique_u64(values: np.ndarray, owned: bool = False) -> np.ndarray:
+    """Sorted-unique uint64 values (np.unique equivalent): LSD radix in
+    C when available — the import path's dominant sort — numpy fallback
+    otherwise. The input is not modified unless ``owned=True`` (the
+    caller hands over a scratch array, e.g. a fresh concatenate result,
+    saving a full copy on the hot path)."""
+    lib = _load()
+    v = np.ascontiguousarray(values, dtype=np.uint64)
+    if lib is None or v.size < 2048:  # call overhead beats tiny inputs
+        return np.unique(v)
+    data = v if (owned and v is values) else v.copy()
+    tmp = np.empty_like(data)
+    n = lib.u64_sort_unique(
+        _ptr(data, ctypes.c_uint64), data.size, _ptr(tmp, ctypes.c_uint64)
+    )
+    return data[:n]
+
+
+def counting_argsort(keys: np.ndarray, max_key: int) -> np.ndarray:
+    """Stable argsort of small-integer uint64 keys in O(n + max_key)
+    (shard grouping: keys are shard ids). Falls back to numpy's stable
+    argsort when the native library is absent or max_key is out of
+    proportion to n (zeroing/scanning the counts buffer would dominate).
+    The C kernel indexes counts[key] unchecked, so the bounds contract
+    is enforced here (same discipline as pack_positions)."""
+    lib = _load()
+    k = np.ascontiguousarray(keys, dtype=np.uint64)
+    if lib is None or k.size < 2048 or max_key > 4 * k.size:
+        return np.argsort(k, kind="stable")
+    if int(k.max()) > max_key:
+        raise IndexError(
+            f"counting_argsort: key {int(k.max())} exceeds max_key {max_key}"
+        )
+    counts = np.zeros(max_key + 1, dtype=np.int64)
+    order = np.empty(k.size, dtype=np.int64)
+    lib.u64_counting_argsort(
+        _ptr(k, ctypes.c_uint64), k.size, max_key,
+        _ptr(counts, ctypes.c_int64), _ptr(order, ctypes.c_int64),
+    )
+    return order
+
+
+def uniq_sorted(arr: np.ndarray):
+    """(unique values, start indices) of an ALREADY-SORTED array in O(n)
+    — np.unique re-sorts, a full radix pass per call on import paths.
+    Shared by the roaring bulk merges and the field shard grouping."""
+    if arr.size == 0:
+        return arr, np.empty(0, dtype=np.int64)
+    mask = np.empty(arr.size, dtype=bool)
+    mask[0] = True
+    np.not_equal(arr[1:], arr[:-1], out=mask[1:])
+    starts = np.flatnonzero(mask)
+    return arr[starts], starts
 
 
 def u64_merge(op: str, a: np.ndarray, b: np.ndarray) -> np.ndarray:

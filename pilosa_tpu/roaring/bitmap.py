@@ -12,6 +12,8 @@ from typing import Iterable, Iterator
 
 import numpy as np
 
+from pilosa_tpu import native
+from pilosa_tpu.native import uniq_sorted as _uniq_sorted
 from pilosa_tpu.roaring import containers as ct
 
 _KEY_SHIFT = np.uint64(16)
@@ -81,9 +83,9 @@ class Bitmap:
         vectorized word-OR each (few — only containers past 4096 bits)."""
         if values.size == 0:
             return
-        values = np.unique(values.astype(np.uint64))
+        values = native.sort_unique_u64(values)
         keys = (values >> _KEY_SHIFT).astype(np.int64)
-        uniq_keys, starts = np.unique(keys, return_index=True)
+        uniq_keys, starts = _uniq_sorted(keys)
         bounds = np.append(starts, keys.size)
         get = self._containers.get
         arr_datas: list[np.ndarray] = []
@@ -100,14 +102,15 @@ class Bitmap:
             else:
                 heavy.append((i, key, c))
         if arr_datas:
-            merged = np.unique(
-                np.concatenate([values, _tagged_concat(arr_keys, arr_datas)])
+            merged = native.sort_unique_u64(
+                np.concatenate([values, _tagged_concat(arr_keys, arr_datas)]),
+                owned=True,  # the concatenate result is scratch
             )
         else:
             merged = values
         if light:
             mkeys = (merged >> _KEY_SHIFT).astype(np.int64)
-            muniq, mstarts = np.unique(mkeys, return_index=True)
+            muniq, mstarts = _uniq_sorted(mkeys)
             mbounds = np.append(mstarts, mkeys.size)
             mlows = (merged & _LOW_MASK).astype(np.uint16)
             pos_of = {int(k): j for j, k in enumerate(muniq.tolist())}
@@ -150,9 +153,9 @@ class Bitmap:
         targets get a vectorized word-ANDNOT each."""
         if values.size == 0:
             return
-        values = np.unique(values.astype(np.uint64))
+        values = native.sort_unique_u64(values)
         keys = (values >> _KEY_SHIFT).astype(np.int64)
-        uniq_keys, starts = np.unique(keys, return_index=True)
+        uniq_keys, starts = _uniq_sorted(keys)
         bounds = np.append(starts, keys.size)
         get = self._containers.get
         arr_datas: list[np.ndarray] = []
@@ -232,7 +235,7 @@ class Bitmap:
         lows = (values & _LOW_MASK).astype(np.uint16)
         order = np.argsort(keys, kind="stable")
         ks = keys[order]
-        uniq, starts = np.unique(ks, return_index=True)
+        uniq, starts = _uniq_sorted(ks)
         bounds = np.append(starts, ks.size)
         arr_parts: list[np.ndarray] = []
         arr_lens: list[int] = []

@@ -67,3 +67,25 @@ def test_pack_positions_bounds_checked():
         native.pack_positions(np.array([70000], dtype=np.int64), 1 << 16)
     with pytest.raises(IndexError):
         native.pack_positions(np.array([-1], dtype=np.int64), 1 << 16)
+
+
+def test_sort_unique_u64_matches_numpy(rng):
+    for n in (0, 1, 100, 5000, 200_000):
+        vals = rng.integers(0, 1 << 63, n, dtype=np.uint64)
+        vals = np.concatenate([vals, vals[: n // 2]])  # force duplicates
+        got = native.sort_unique_u64(vals)
+        want = np.unique(vals)
+        assert np.array_equal(got, want), n
+    # clustered values exercise the skip-constant-byte passes
+    vals = (np.uint64(7) << np.uint64(20)) + rng.integers(
+        0, 1 << 20, 100_000, dtype=np.uint64
+    )
+    assert np.array_equal(native.sort_unique_u64(vals), np.unique(vals))
+
+
+def test_counting_argsort_matches_numpy(rng):
+    for n in (0, 1, 5000, 100_000):
+        keys = rng.integers(0, 37, n, dtype=np.uint64)
+        got = native.counting_argsort(keys, 36)
+        want = np.argsort(keys, kind="stable")
+        assert np.array_equal(got, want), n
