@@ -80,6 +80,19 @@ def cmd_server(args) -> int:
     srv = Server(cfg)
     srv.open()
     print(f"pilosa-tpu server listening on {srv.uri}", flush=True)
+    profiler = None
+    if args.cpu_profile:
+        # reference: the server command's cpu-profile flag. A SAMPLING
+        # profiler over ALL threads (cProfile hooks only the enabling
+        # thread — request handling runs on the HTTP server's worker
+        # threads, which it would never see); the dump is folded-stack
+        # text, directly consumable by flamegraph tooling. The output
+        # path is opened up front so a bad path fails at startup, not
+        # after hours of serving.
+        from pilosa_tpu.utils.profiling import WholeRunSampler
+
+        profiler = WholeRunSampler(open(args.cpu_profile, "w"))
+        profiler.start()
     stop = []
     signal.signal(signal.SIGTERM, lambda *a: stop.append(1))
     try:
@@ -87,7 +100,14 @@ def cmd_server(args) -> int:
             signal.pause()
     except KeyboardInterrupt:
         pass
-    srv.close()
+    finally:
+        if profiler is not None:
+            try:
+                profiler.stop()
+                print(f"cpu profile written to {args.cpu_profile}", flush=True)
+            except OSError as e:
+                print(f"cpu profile write failed: {e}", flush=True)
+        srv.close()
     return 0
 
 
@@ -212,6 +232,12 @@ def main(argv: list[str] | None = None) -> int:
         "--tls-skip-verify",
         action="store_true",
         help="trust self-signed peer certificates",
+    )
+    s.add_argument(
+        "--cpu-profile",
+        default=None,
+        metavar="FILE",
+        help="write a cProfile pstats dump of the whole run on shutdown",
     )
     s.set_defaults(fn=cmd_server)
 

@@ -39,8 +39,7 @@ def _shard_slices(cols: np.ndarray):
     from pilosa_tpu import native
 
     shards = cols // np.uint64(SHARD_WIDTH)
-    max_shard = int(shards.max()) if shards.size else 0
-    order = native.counting_argsort(shards, max_shard)
+    order = native.counting_argsort(shards)
     uniq, starts = native.uniq_sorted(shards[order])
     bounds = np.append(starts, order.size)
     for i, shard in enumerate(uniq.tolist()):

@@ -55,6 +55,20 @@ def _load() -> ctypes.CDLL | None:
             lib = ctypes.CDLL(_LIB)
         except OSError:
             return None
+        try:
+            return _bind(lib)
+        except AttributeError:
+            # a stale prebuilt .so (mtime-preserving deploys) missing a
+            # newer symbol must degrade to the numpy fallbacks, not crash
+            # every native entry point
+            return None
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    """Declare every symbol's signature; AttributeError (stale .so)
+    propagates to _load's fallback."""
+    global _lib, AVAILABLE
+    if True:  # keep the binding block's indentation stable
         c_u32p = ctypes.POINTER(ctypes.c_uint32)
         c_u64p = ctypes.POINTER(ctypes.c_uint64)
         c_i64p = ctypes.POINTER(ctypes.c_int64)
@@ -173,21 +187,21 @@ def sort_unique_u64(values: np.ndarray, owned: bool = False) -> np.ndarray:
     return data[:n]
 
 
-def counting_argsort(keys: np.ndarray, max_key: int) -> np.ndarray:
+def counting_argsort(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of small-integer uint64 keys in O(n + max_key)
-    (shard grouping: keys are shard ids). Falls back to numpy's stable
-    argsort when the native library is absent or max_key is out of
-    proportion to n (zeroing/scanning the counts buffer would dominate).
-    The C kernel indexes counts[key] unchecked, so the bounds contract
-    is enforced here (same discipline as pack_positions)."""
+    (shard grouping: keys are shard ids). Computes the key maximum
+    itself — ONE scan doubles as the bounds guarantee for the unchecked
+    C write (same discipline as pack_positions). Falls back to numpy's
+    stable argsort when the native library is absent or the key range is
+    out of proportion to n (zeroing/scanning the counts buffer would
+    dominate)."""
     lib = _load()
     k = np.ascontiguousarray(keys, dtype=np.uint64)
-    if lib is None or k.size < 2048 or max_key > 4 * k.size:
+    if lib is None or k.size < 2048:
         return np.argsort(k, kind="stable")
-    if int(k.max()) > max_key:
-        raise IndexError(
-            f"counting_argsort: key {int(k.max())} exceeds max_key {max_key}"
-        )
+    max_key = int(k.max())
+    if max_key > 4 * k.size:
+        return np.argsort(k, kind="stable")
     counts = np.zeros(max_key + 1, dtype=np.int64)
     order = np.empty(k.size, dtype=np.int64)
     lib.u64_counting_argsort(

@@ -56,6 +56,47 @@ def sample_profile(seconds: float = 5.0, hz: int = 100) -> str:
     return "\n".join(lines) + "\n"
 
 
+class WholeRunSampler:
+    """Whole-run sampling profiler over ALL threads (the server
+    command's cpu-profile flag): a daemon thread samples
+    sys._current_frames at ``hz`` until stop(), then writes folded-stack
+    lines to ``out`` (an open text file — opened by the caller so a bad
+    path fails at startup). Memory is bounded by the number of DISTINCT
+    stacks, not run length."""
+
+    def __init__(self, out, hz: int = 50):
+        self._out = out
+        self._interval = 1.0 / max(1, hz)
+        self._counts: Counter[str] = Counter()
+        self._n = 0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="cpu-profile-sampler", daemon=True
+        )
+        self._t0 = time.perf_counter()
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def _run(self) -> None:
+        me = threading.get_ident()
+        while not self._stop.is_set():
+            for tid, frame in sys._current_frames().items():
+                if tid != me:
+                    self._counts[_folded(frame)] += 1
+            self._n += 1
+            self._stop.wait(self._interval)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        elapsed = time.perf_counter() - self._t0
+        with self._out as f:
+            f.write(f"# {self._n} samples over {elapsed:.1f}s\n")
+            for stack, n in self._counts.most_common():
+                f.write(f"{stack} {n}\n")
+
+
 def thread_dump() -> str:
     """Stack of every live thread (goroutine-dump analogue)."""
     frames = sys._current_frames()
