@@ -86,6 +86,25 @@ def config1_pql_single_shard():
     t_host = timeit(host, 50)
     line("pql_intersect_count_1M_qps", 1 / t_dev, "qps", t_host / t_dev)
 
+    # SYNC multi-count requests: counts dispatch async in program order
+    # and resolve in ONE readback wave, so a 16-count request pays one
+    # transport RTT instead of 16 — counts/s here ≈ 16× the
+    # single-count sync rate on a high-RTT transport
+    multi = " ".join([pql] * 16)
+    assert e.execute("bench", multi) == [host()] * 16  # the batched wave
+
+    def multi_sync():
+        return e.execute("bench", multi)
+
+    t_multi = timeit(multi_sync, 10)
+    t_single = timeit(lambda: e.execute("bench", pql), 10)
+    line(
+        "pql_multicount_sync_counts_per_s",
+        16 / t_multi,
+        "counts/s",
+        (16 / t_multi) * t_single,
+    )
+
 
 def config2_multi_shard_setops():
     import jax
