@@ -175,3 +175,32 @@ def test_statsd_emission(tmp_path):
     finally:
         sink.close()
         s.close()
+
+
+def test_whole_run_sampler_sees_worker_threads(tmp_path):
+    """The --cpu-profile sampler must capture NON-main threads (cProfile
+    would only see the enabling thread) and bound memory by distinct
+    stacks."""
+    import threading
+    import time
+
+    from pilosa_tpu.utils.profiling import WholeRunSampler
+
+    out = tmp_path / "prof.folded"
+    stop = threading.Event()
+
+    def spin_worker():
+        while not stop.is_set():
+            time.sleep(0.001)
+
+    t = threading.Thread(target=spin_worker, name="spinner", daemon=True)
+    t.start()
+    sampler = WholeRunSampler(open(out, "w"), hz=200)
+    sampler.start()
+    time.sleep(0.5)
+    sampler.stop()
+    stop.set()
+    t.join(timeout=2)
+    text = out.read_text()
+    assert text.startswith("#")  # header with sample count
+    assert "spin_worker" in text  # the worker thread's stack was sampled
