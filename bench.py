@@ -244,8 +244,9 @@ def _child_main(n_shards: int) -> None:
 def _run_child(n_shards: int, timeout_s: float, extra_env: dict | None = None):
     env = dict(os.environ)
     env["PILOSA_BENCH_CHILD_SHARDS"] = str(n_shards)
-    # the resident stack is [S, R_PAD, W] — raise the device budget to
-    # fit it (read at import time by executor/compile.py)
+    # the resident stack is [R_PAD, S, W] — raise the device budget to
+    # fit it (resolved lazily on first stack admit and cached per
+    # process; the child's env is set before spawn, so this always wins)
     from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 
     env.setdefault(

@@ -112,6 +112,31 @@ def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
 # scatter index sentinel: out of bounds on any axis ⇒ mode="drop" skips it
 _OOB = np.int32(2**30)
 
+_budget_cache: list[int] = []
+
+
+def _stack_budget() -> int:
+    """See StackCache.STACK_BYTES_BUDGET. Cached after first resolution
+    (device memory limits don't change mid-process)."""
+    if _budget_cache:
+        return _budget_cache[0]
+    env = os.environ.get("PILOSA_TPU_STACK_BUDGET")
+    if env:
+        budget = int(env)
+    else:
+        budget = 0
+        try:
+            stats = jax.local_devices()[0].memory_stats() or {}
+            # 70% of reported HBM even when that is below 2 GiB — the
+            # headroom matters more on small devices, not less
+            budget = int(int(stats.get("bytes_limit", 0)) * 0.7)
+        except Exception:
+            pass  # backend without memory stats (e.g. CPU)
+        if budget <= 0:
+            budget = 2 << 30
+    _budget_cache.append(budget)
+    return budget
+
 
 @jax.jit
 def _apply_stack_delta(matrix, idx, rows):
@@ -141,9 +166,16 @@ class StackCache:
 
     MAX_ENTRIES = 64
     MAX_DELTA_ROWS = 1024  # beyond this a full restack is cheaper
+
     # device-bytes cap for any one dense stack; larger fields take the
-    # hot-row path (env override for tests/operators)
-    STACK_BYTES_BUDGET = int(os.environ.get("PILOSA_TPU_STACK_BUDGET", 2 << 30))
+    # hot-row path. Resolution order: PILOSA_TPU_STACK_BUDGET env →
+    # 70% of the device's reported HBM limit (a 16 GiB chip serves a
+    # 10 GiB pod-scale stack out of the box) → 2 GiB. Lazy so importing
+    # the module never initializes a backend; tests monkeypatch the
+    # class attribute with a plain int, which shadows the property.
+    @property
+    def STACK_BYTES_BUDGET(self) -> int:  # noqa: N802 — historical name
+        return _stack_budget()
 
     def __init__(self, mesh_ctx=None):
         from collections import OrderedDict
@@ -152,11 +184,44 @@ class StackCache:
         self._hot: "OrderedDict[tuple, dict]" = OrderedDict()
         self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
         self._lock = threading.Lock()
+        # shared byte ledger across BOTH caches: the budget is an
+        # AGGREGATE resident cap, not just per-stack — a per-entry check
+        # alone would let two near-budget stacks coexist and OOM the
+        # device once the budget scales to 70% of HBM
+        self._bytes: dict[tuple, int] = {}
+        self.resident_bytes = 0
         # observability: tests assert the write path stays incremental
         self.full_restacks = 0
         self.delta_updates = 0
         self.delta_rows_uploaded = 0
         self.hot_row_uploads = 0
+
+    # ----------------------------------------------------- byte ledger
+    # callers hold self._lock
+    def _account(self, key: tuple, nbytes: int) -> None:
+        self.resident_bytes += nbytes - self._bytes.get(key, 0)
+        self._bytes[key] = nbytes
+
+    def _forget(self, key: tuple) -> None:
+        self.resident_bytes -= self._bytes.pop(key, 0)
+
+    def _evict_for(self, need: int, keep: tuple | None = None) -> None:
+        """Evict LRU entries (dense first, then hot) until ``need`` more
+        bytes fit under the budget. The entry being (re)built is exempt;
+        if nothing evictable remains the admit proceeds anyway — the
+        per-stack check already bounds any single entry."""
+        budget = self.STACK_BYTES_BUDGET
+        while self.resident_bytes + need > budget:
+            victim = next((k for k in self._cache if k != keep), None)
+            if victim is not None:
+                del self._cache[victim]
+                self._forget(victim)
+                continue
+            victim = next((k for k in self._hot if k != keep), None)
+            if victim is None:
+                break
+            del self._hot[victim]
+            self._forget(victim)
 
     @staticmethod
     def _projected_rows(view, shards: list[int]) -> int:
@@ -204,6 +269,12 @@ class StackCache:
                 field.name, r_pad, need, self.STACK_BYTES_BUDGET
             )
         with self._lock:
+            # evict for the PROJECTED bytes BEFORE the build allocates on
+            # device — evicting only at install would let the new stack
+            # coexist with victims at ~2× budget peak (a same-key rebuild
+            # still transiently holds old+new; concurrent readers may use
+            # the old array, so it cannot be dropped early)
+            self._evict_for(need - self._bytes.get(key, 0), keep=key)
             cached = self._cache.get(key)
             versions = tuple(self._frag_token(view, s) for s in shards)
             if cached is not None and cached[0] == versions:
@@ -231,10 +302,14 @@ class StackCache:
             # last-writer-wins install is self-healing: if a concurrent
             # builder installed a different entry, the next call re-reads
             # fragment versions and reconciles via the delta path
+            nbytes = int(entry[1].nbytes)
+            self._evict_for(nbytes - self._bytes.get(key, 0), keep=key)
             self._cache[key] = entry
+            self._account(key, nbytes)
             self._cache.move_to_end(key)
             while len(self._cache) > self.MAX_ENTRIES:
-                self._cache.popitem(last=False)
+                victim, _ = self._cache.popitem(last=False)
+                self._forget(victim)
             return entry[1], entry[2]
 
     def _try_delta(self, cached, view, shards: list[int], versions: tuple, view_ver):
@@ -304,10 +379,14 @@ class StackCache:
                 "hotRowUploads": self.hot_row_uploads,
                 "entries": len(self._cache),
                 "hotEntries": len(self._hot),
+                "residentBytes": self.resident_bytes,
+                "budgetBytes": self.STACK_BYTES_BUDGET,
             }
 
     def invalidate(self) -> None:
         with self._lock:
+            self._bytes.clear()
+            self.resident_bytes = 0
             self._cache.clear()
             self._hot.clear()
 
@@ -319,10 +398,16 @@ class StackCache:
     # host matrix (SURVEY §7 hard part (e)).
 
     def hot_capacity(self, n_shards: int) -> int:
-        h = self.STACK_BYTES_BUDGET // max(1, n_shards * WORDS_PER_SHARD * 4)
+        # HALF the aggregate budget: a full-budget slot stack would be
+        # mutually exclusive with every dense stack, and a hybrid query
+        # (dense field ∩ hot field) would evict one to admit the other
+        # on every request — permanent restack/re-promotion thrash
+        h = (self.STACK_BYTES_BUDGET // 2) // max(
+            1, n_shards * WORDS_PER_SHARD * 4
+        )
         return max(8, 1 << (int(h).bit_length() - 1)) if h >= 8 else 8
 
-    MAX_HOT_ENTRIES = 4  # each slot stack is up to a full budget of HBM
+    MAX_HOT_ENTRIES = 4  # count cap; the byte ledger is the real bound
 
     def _hot_entry(self, idx: Index, field: Field, view_name: str, shards):
         view = field.view(view_name)
@@ -345,6 +430,7 @@ class StackCache:
             from collections import OrderedDict
 
             zeros = np.zeros((h, len(shards), WORDS_PER_SHARD), dtype=np.uint32)
+            self._evict_for(int(zeros.nbytes) - self._bytes.get(key, 0), keep=key)
             dev = (
                 self.mesh_ctx.place_stack(zeros)
                 if self.mesh_ctx is not None
@@ -358,9 +444,11 @@ class StackCache:
                 "view_ver": view_ver,
             }
             self._hot[key] = entry
+            self._account(key, int(zeros.nbytes))
             self._hot.move_to_end(key)
             while len(self._hot) > self.MAX_HOT_ENTRIES:
-                self._hot.popitem(last=False)
+                victim, _ = self._hot.popitem(last=False)
+                self._forget(victim)
             return entry, view
         self._hot.move_to_end(key)
         if entry["versions"] != versions:

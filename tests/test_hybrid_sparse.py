@@ -170,3 +170,49 @@ def test_groupby_over_budget_nested_with_filter(tight_budget):
             np.count_nonzero((rows == big_r) & (cols % 2 == small_r))
         )
         assert entry["count"] == expect, (big_r, small_r)
+
+
+def test_stack_budget_resolution(monkeypatch):
+    """Budget order: env override → 70% of device HBM limit → 2 GiB
+    floor; resolution is cached once per process."""
+    from pilosa_tpu.executor import compile as C
+
+    monkeypatch.setattr(C, "_budget_cache", [])
+    monkeypatch.setenv("PILOSA_TPU_STACK_BUDGET", "12345")
+    assert C._stack_budget() == 12345
+    monkeypatch.setattr(C, "_budget_cache", [])
+    monkeypatch.delenv("PILOSA_TPU_STACK_BUDGET", raising=False)
+    # without env: 70% of the device's reported limit, else the 2 GiB
+    # default — either way strictly positive
+    assert C._stack_budget() > 0
+    # instances see the property; a monkeypatched class int shadows it
+    monkeypatch.setattr(C.StackCache, "STACK_BYTES_BUDGET", 777)
+    assert C.StackCache().STACK_BYTES_BUDGET == 777
+
+
+def test_aggregate_budget_evicts_lru_stack(monkeypatch):
+    """The budget caps TOTAL resident stack bytes, not just each stack:
+    admitting a second near-budget stack must evict the first (LRU)
+    instead of holding both on device."""
+    from pilosa_tpu.executor import compile as C
+
+    h = Holder(None)
+    idx = h.create_index("agg")
+    fa = idx.create_field("a")
+    fb = idx.create_field("b")
+    for f in (fa, fb):
+        f.import_bulk(
+            np.array([0, 1], dtype=np.uint64), np.array([1, 2], dtype=np.uint64)
+        )
+    one_stack = 8 * WORDS_PER_SHARD * 4  # [R_pad=8, S=1, W] uint32
+    monkeypatch.setattr(C.StackCache, "STACK_BYTES_BUDGET", int(one_stack * 1.5))
+    e = Executor(h)
+    stacks = e.compiler.stacks
+    stacks.matrix(idx, fa, "standard", [0])
+    assert stacks.resident_bytes == one_stack
+    stacks.matrix(idx, fb, "standard", [0])  # must evict field a's stack
+    assert stacks.resident_bytes == one_stack
+    assert len(stacks._cache) == 1
+    # field a rebuilds on demand — correctness is unaffected
+    assert e.execute("agg", "Count(Row(a=0))", shards=[0])[0] == 1
+    assert e.execute("agg", "Count(Row(b=1))", shards=[0])[0] == 1
