@@ -222,7 +222,6 @@ class Handler(BaseHTTPRequestHandler):
 
     # -------------------------------------------------------------- routes
     def h_query(self, index: str) -> None:
-        import sys
         import time
 
         body = self._body()
@@ -239,10 +238,8 @@ class Handler(BaseHTTPRequestHandler):
         elapsed = time.perf_counter() - t0
         slow = self.server.long_query_time
         if slow > 0 and elapsed >= slow:
-            print(
-                f"[pilosa-tpu] long query ({elapsed:.3f}s) index={index}: "
-                f"{pql[:200]}",
-                file=sys.stderr,
+            self.server.log(
+                f"long query ({elapsed:.3f}s) index={index}: {pql[:200]}"
             )
         if proto:
             self._proto(encoding.protoser.response_to_bytes(resp))
@@ -455,6 +452,11 @@ class HTTPServer(ThreadingHTTPServer):
         self.stats = stats or StatsClient()
         self.node_id = "local"
         self.long_query_time = 0.0
+        # the runtime Server replaces this with its configured Logger's
+        # log; the default gives standalone HTTPServers the same sink
+        from pilosa_tpu.utils.log import Logger
+
+        self.log = Logger().log
         self.extra_routes: dict = {}
         self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
         self.import_router = self._local_import

@@ -27,6 +27,13 @@ class Server:
         self.stats = make_stats(
             self.config.metric_service, self.config.statsd_host
         )
+        from pilosa_tpu.utils.log import Logger
+
+        self.logger = Logger(
+            os.path.expanduser(self.config.log_path)
+            if self.config.log_path
+            else None
+        )
         self.cluster = None
         # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
         # backend (seconds, or worse on a wedged transport) — that must
@@ -71,6 +78,7 @@ class Server:
             self.http.ssl_context = ctx
         self.http.node_id = self.config.node_id
         self.http.long_query_time = self.config.long_query_time
+        self.http.log = self.logger.log
         if self.config.seeds or self.config.coordinator:
             from pilosa_tpu.parallel.cluster import Cluster
 
@@ -157,3 +165,4 @@ class Server:
             self.http.server_close()
         self.stats.close()
         self.holder.close()
+        self.logger.close()

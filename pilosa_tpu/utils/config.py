@@ -28,6 +28,7 @@ class Config:
     # limits
     max_writes_per_request: int = 5000
     long_query_time: float = 0.0  # seconds; log slower queries (0 = off)
+    log_path: str = ""  # append server log lines to a file ("" = stderr)
     # device mesh (serving-path SPMD over all local devices)
     mesh_enabled: bool = True
     mesh_words_axis: int = 1  # >1 splits the packed word dim across devices

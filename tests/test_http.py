@@ -231,3 +231,27 @@ def test_fragment_export_formats(srv):
     raw = call(srv, "GET", "/index/fx/field/f/fragment/data?shard=7", raw=True)
     b, _ = roaring.deserialize(raw)
     assert b.count() == 0
+
+
+def test_long_query_log_to_file(tmp_path):
+    """log-path routes server log lines (long-query warnings) to a file
+    (reference: Config.LogPath + the Logger interface)."""
+    log_file = tmp_path / "server.log"
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "data"),
+            anti_entropy_interval=0,
+            long_query_time=0.000001,  # everything is "long"
+            log_path=str(log_file),
+        )
+    )
+    s.open()
+    try:
+        call(s, "POST", "/index/lq", {})
+        call(s, "POST", "/index/lq/field/f", {})
+        call(s, "POST", "/index/lq/query", b"Count(Row(f=1))")
+    finally:
+        s.close()
+    text = log_file.read_text()
+    assert "long query" in text and "index=lq" in text
