@@ -84,6 +84,20 @@ class View:
     def available_shards(self) -> set[int]:
         return set(self.fragments)
 
+    def remove_fragment(self, shard: int) -> bool:
+        """Drop a fragment and its on-disk file — the relinquish half of a
+        cluster resize handoff (reference: fragment deletion in
+        ResizeJob). Bumps the view version so device stack caches built
+        over the old shard set invalidate."""
+        frag = self.fragments.pop(shard, None)
+        if frag is None:
+            return False
+        self._bump_version()
+        frag.close()
+        if frag.path and os.path.exists(frag.path):
+            os.remove(frag.path)
+        return True
+
     def close(self) -> None:
         for frag in self.fragments.values():
             frag.close()

@@ -84,6 +84,7 @@ class Cluster:
         # of a silently partial result
         self._peer_shards: dict[tuple[str, str], set[int]] = {}
         self._hb_timer: threading.Timer | None = None
+        self._rebalance_thread: threading.Thread | None = None
         self._closed = False
 
     # ------------------------------------------------------------ membership
@@ -104,14 +105,129 @@ class Cluster:
         self.server.http.broadcast_deletion = self.broadcast_deletion
 
     def join(self) -> None:
-        """Heartbeat + pull recovery, then STARTING → NORMAL (reference:
-        cluster state negotiation in Server.Open). Runs after the
-        listener is up so concurrent cold starts don't stack probe
-        timeouts on bound-but-not-serving sockets."""
+        """Heartbeat + announce-if-new + pull recovery, then STARTING →
+        NORMAL (reference: cluster state negotiation in Server.Open).
+        Runs after the listener is up so concurrent cold starts don't
+        stack probe timeouts on bound-but-not-serving sockets."""
+        # announce BEFORE the first heartbeat: a moved node adopting a
+        # higher-epoch peer list that still carries its OLD address would
+        # read itself as removed — announcing first makes every peer
+        # replace the stale entry, so the adoption that follows includes
+        # our current URI
+        self._announce_if_new()
         self._heartbeat_once()
         self._recover_on_join()
         self.state = STATE_NORMAL
         self._schedule_heartbeat()
+
+    def _announce_if_new(self) -> None:
+        """Cluster growth, the joiner's half (reference: memberlist join →
+        cluster.go ResizeJob add). If an alive peer's membership list
+        lacks this node, the cluster predates us: announce the join so
+        every member inserts us and bumps the topology epoch — which also
+        protects us from being reaped by a node that missed the announce
+        (it adopts the higher-epoch list instead). Afterwards adopt the
+        freshest peer list so a single-seed join still learns the full
+        membership before pulling its shards."""
+        for n in self._peers():
+            try:
+                st = self.client.status(n.uri, timeout=5.0)
+            except PeerError:
+                continue
+            uris = {d.get("uri") for d in st.get("nodes", [])}
+            if self.me.uri in uris:
+                continue
+            try:
+                self.client._json(
+                    "POST",
+                    n.uri,
+                    "/internal/cluster/join",
+                    {"id": self.me.id, "uri": self.me.uri},
+                )
+            except PeerError:
+                continue
+        # Adopt the freshest peer list OUTRIGHT (>=, not >): whether we
+        # just announced or are a restarted member whose seed-derived
+        # list predates later growth, peers at an equal-or-higher epoch
+        # know at least as much as our config does. Without this, a
+        # restarted node whose seeds name only the original members would
+        # sync epochs in heartbeats but never learn the joined nodes —
+        # and route reads across a phantom sub-cluster.
+        best: tuple[int, list[dict]] | None = None
+        for n in self._peers():
+            try:
+                st = self.client.status(n.uri, timeout=5.0)
+            except PeerError:
+                continue
+            ep = st.get("topologyEpoch")
+            peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
+            if isinstance(ep, int) and peer_nodes and (
+                best is None or ep > best[0]
+            ):
+                best = (ep, peer_nodes)
+        if best is not None and best[0] >= self.topology.epoch:
+            my_uris = {x.uri for x in self.nodes}
+            if best[0] > self.topology.epoch or {
+                d["uri"] for d in best[1]
+            } != my_uris:
+                self._adopt_topology(*best)
+
+    def add_node(self, node_id: str, uri: str, forward: bool = True) -> bool:
+        """Insert a joining node into the local topology (reference:
+        cluster.go addNode on a memberlist join event). Idempotent by
+        URI — only an ACTUAL insert bumps the epoch, so a direct announce
+        racing a forwarded one can't double-bump. Forwards the join to
+        every other peer once (forward=False on the forwarded leg stops
+        the flood); a peer the forward misses converges by adopting the
+        higher-epoch list at its next heartbeat."""
+        if any(n.uri == uri for n in self.nodes):
+            return False  # idempotent by URI — the guard must NOT match
+            # by id, or a member rejoining from a new address would be
+            # refused and then self-remove on adopting a list without it
+        stale = next((n for n in self.nodes if n.id == node_id), None)
+        if stale is not None and stale.id != self.me.id:
+            # same id, new address: the node moved — retire the old entry
+            self.topology.remove(stale.id)
+        node = Node(id=node_id, uri=uri)
+        self.topology.add(node)
+        if forward:
+            for n in self._peers(alive_only=False):
+                if n.uri == uri:
+                    continue
+                try:
+                    self.client._json(
+                        "POST",
+                        n.uri,
+                        "/internal/cluster/join",
+                        {"id": node_id, "uri": uri, "forwarded": True},
+                    )
+                except PeerError:
+                    pass
+        # Growth reshuffles placement among the OLD nodes too
+        # (partition % n): pull any shards this node now owns but doesn't
+        # hold, or reads routed here would silently undercount. The pull
+        # runs OFF the join-handler thread — a synchronous pull would
+        # stall the joiner's announce past its RPC timeout on any cluster
+        # holding real data. Mid-pull reads may transiently undercount on
+        # this node exactly as they would for any not-yet-synced replica;
+        # the import re-forward path keeps writes landing correctly.
+        # The joiner itself pulls synchronously in _recover_on_join;
+        # fragments this node no longer owns hand off at the next
+        # anti-entropy pass.
+        def rebalance():
+            prev_state, self.state = self.state, STATE_RESIZING
+            try:
+                self._pull_owned_fragments(
+                    [n for n in self._peers() if n.uri != uri]
+                )
+            finally:
+                if self.state == STATE_RESIZING:
+                    self.state = prev_state
+
+        t = threading.Thread(target=rebalance, daemon=True, name="join-rebalance")
+        self._rebalance_thread = t
+        t.start()
+        return True
 
     def _check_ready(self) -> None:
         self._check_not_removed()
@@ -134,7 +250,15 @@ class Cluster:
 
     def _heartbeat_once(self) -> None:
         degraded = False
-        stale_ids: set[str] = set()
+        # Topology reconciliation is EPOCH-based: every applied add/remove
+        # bumps Topology.epoch, and a node that missed the broadcast
+        # adopts the higher-epoch membership list wholesale. This
+        # converges both directions — a missed removal shrinks us, and a
+        # missed JOIN grows us instead of the old behavior of reaping the
+        # announced joiner as stale (the round-3 self-removal hazard).
+        # Match on URI, not id: ids are config-dependent (a node's own id
+        # may be its `name` while peers know it by host:port).
+        best: tuple[int, list[dict]] | None = None
         for n in self._peers(alive_only=False):
             try:
                 st = self.client.status(n.uri, timeout=5.0)
@@ -143,20 +267,67 @@ class Cluster:
                 n.alive = False
                 degraded = True
                 continue
-            # topology reconciliation: a peer that no longer lists a node
-            # observed an administrative removal this node missed (e.g. a
-            # dropped remove-node broadcast). Converge toward removal.
-            # Match on URI, not id: ids are config-dependent (a node's own
-            # id may be its `name` while peers know it by host:port).
-            peer_uris = {d["uri"] for d in st.get("nodes", []) if d.get("uri")}
-            if peer_uris:
-                for x in self.nodes:
-                    if x.uri != n.uri and x.uri not in peer_uris:
-                        stale_ids.add(x.id)
+            ep = st.get("topologyEpoch")
+            peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
+            if not isinstance(ep, int) or not peer_nodes:
+                continue
+            if ep > self.topology.epoch and (best is None or ep > best[0]):
+                best = (ep, peer_nodes)
+            elif (
+                ep == self.topology.epoch
+                and best is None
+                and n.is_coordinator
+                and not self.me.is_coordinator
+                and {d["uri"] for d in peer_nodes} != {x.uri for x in self.nodes}
+            ):
+                # equal epochs with divergent membership (concurrent
+                # add/remove applied on disjoint subsets): epochs alone
+                # can't order the lists, so the coordinator's view is
+                # authoritative — everyone converges to it (reference:
+                # the coordinator owns ResizeJob decisions)
+                best = (ep, peer_nodes)
+        if best is not None:
+            self._adopt_topology(*best)
         if self.state in (STATE_NORMAL, STATE_DEGRADED):
             self.state = STATE_DEGRADED if degraded else STATE_NORMAL
-        for x_id in stale_ids:
-            self.remove_node(x_id, broadcast=False)
+
+    def _adopt_topology(self, epoch: int, node_dicts: list[dict]) -> None:
+        """Adopt a peer's higher-epoch membership list. Keeps this node's
+        own Node object and known liveness flags; newly learned members
+        start alive (the next heartbeat corrects). If the adopted list no
+        longer contains us, the cluster converged on our removal."""
+        self.topology.epoch = epoch
+        if not any(d["uri"] == self.me.uri for d in node_dicts):
+            self.removed = True
+            self.state = STATE_REMOVED
+            return
+        by_uri = {x.uri: x for x in self.nodes}
+        new_nodes: list[Node] = []
+        grew = False
+        for d in node_dicts:
+            if d["uri"] == self.me.uri:
+                new_nodes.append(self.me)
+                continue
+            known = by_uri.get(d["uri"])
+            if known is not None:
+                known.id = d["id"]
+                known.is_coordinator = bool(d.get("isCoordinator"))
+                new_nodes.append(known)
+            else:
+                grew = True
+                new_nodes.append(
+                    Node(
+                        id=d["id"],
+                        uri=d["uri"],
+                        is_coordinator=bool(d.get("isCoordinator")),
+                    )
+                )
+        self.topology.nodes = sorted(new_nodes, key=lambda x: x.id)
+        if grew:
+            # placement reshuffles on growth (partition % n): pull any
+            # shards this node NOW owns but doesn't hold; fragments we no
+            # longer own hand off at the next anti-entropy pass
+            self._pull_owned_fragments(self._peers())
 
     def _schedule_heartbeat(self) -> None:
         if self._closed:
@@ -237,13 +408,26 @@ class Cluster:
                         continue
                     field = frag_info["field"]
                     view = frag_info["view"]
-                    if self._local_fragment(idx_name, field, view, shard) is not None:
-                        continue
+                    # Merge even when a local fragment exists: a write
+                    # that raced in mid-join may have created it with
+                    # only the new bits — skipping would orphan the
+                    # source's older bits until anti-entropy. A missing
+                    # fragment takes the full-serialization fast path; an
+                    # existing one takes the block-checksum diff so a
+                    # routine restart doesn't re-download in-sync data.
+                    local = self._local_fragment(idx_name, field, view, shard)
                     try:
-                        data = self.client.retrieve_fragment(
-                            src.uri, idx_name, field, view, shard
-                        )
-                        api.import_roaring(idx_name, field, shard, data, view=view)
+                        if local is None:
+                            data = self.client.retrieve_fragment(
+                                src.uri, idx_name, field, view, shard
+                            )
+                            api.import_roaring(
+                                idx_name, field, shard, data, view=view
+                            )
+                        else:
+                            self._sync_fragment(
+                                idx_name, field, view, shard, local, src
+                            )
                     except PeerError:
                         continue
 
@@ -610,6 +794,13 @@ class Cluster:
             by_node,
             node_by_id,
         )
+
+    def wait_rebalanced(self, timeout: float | None = None) -> None:
+        """Block until the background join-rebalance pull (if any) has
+        finished — test/ops hook for deterministic growth sequencing."""
+        t = self._rebalance_thread
+        if t is not None:
+            t.join(timeout)
 
     def _translate_read_keys(self, index: str, call: Call) -> Call:
         """Rewrite string row keys to IDs before fan-out, consulting the
@@ -1023,6 +1214,16 @@ class Cluster:
                 for v_name, view in list(f.views.items()):
                     for shard, frag in list(view.fragments.items()):
                         owners = self.shard_nodes(idx_name, shard)
+                        if not any(o.id == self.me.id for o in owners):
+                            # resize handoff: a fragment this node no
+                            # longer owns is push-merged to every current
+                            # owner, then dropped — writes that raced the
+                            # topology change onto the old owner are
+                            # preserved by the union merge
+                            self._handoff_fragment(
+                                idx_name, f_name, v_name, shard, frag, view, owners
+                            )
+                            continue
                         for owner in owners:
                             if owner.id == self.me.id or not owner.alive:
                                 continue
@@ -1034,6 +1235,33 @@ class Cluster:
                                 continue
             self._sync_attr_stores(idx_name, idx)
         self._tail_translations()
+
+    def _handoff_fragment(
+        self, index, field, view_name, shard, frag, view, owners: list[Node]
+    ) -> None:
+        """Relinquish a no-longer-owned fragment (the drop half of the
+        reference's ResizeJob): union-merge its bits into EVERY current
+        owner, and delete the local copy only when all owners took the
+        push — a dead owner keeps the copy alive for the next pass."""
+        if not owners:
+            return  # no current owners (shouldn't happen); keep the data
+        v0 = frag.version
+        data = serialize(frag.bitmap)
+        for owner in owners:
+            if not self._probe_alive(owner):
+                return
+            try:
+                self.client.import_roaring(
+                    owner.uri, index, field, view_name, shard, data
+                )
+            except PeerError:
+                return
+        if frag.version != v0:
+            # a write raced in after the serialize — its bits aren't in
+            # what we pushed, so keep the copy; the next anti-entropy
+            # pass re-pushes and retires it
+            return
+        view.remove_fragment(shard)
 
     def _sync_attr_stores(self, idx_name: str, idx) -> None:
         """Block-checksum diff of the column/row attr stores against all
@@ -1155,6 +1383,10 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/cluster/resize/remove-node$"),
             ): self._h_remove_node,
+            (
+                "POST",
+                re.compile(r"^/internal/cluster/join$"),
+            ): self._h_join,
         }
         http.extra_routes.update(routes)
 
@@ -1239,6 +1471,18 @@ class Cluster:
         )
         handler._json({"success": removed, "state": self.state})
 
+    def _h_join(self, handler) -> None:
+        body = handler._json_body()
+        node_id, uri = body.get("id"), body.get("uri")
+        if not node_id or not uri:
+            raise ValueError("join requires 'id' and 'uri'")
+        added = self.add_node(
+            node_id, uri, forward=not body.get("forwarded", False)
+        )
+        handler._json(
+            {"success": added, "topologyEpoch": self.topology.epoch}
+        )
+
     def _h_inventory(self, handler) -> None:
         index = handler.query_params["index"][0]
         idx = self.server.holder.index(index)
@@ -1253,12 +1497,53 @@ class Cluster:
         handler._json({"fragments": frags})
 
     def _h_import_bits(self, handler, index: str, field: str) -> None:
-        self.server.api.import_bits(index, field, handler._json_body())
+        self._apply_or_reforward_import(
+            index, field, handler._json_body(), values=False
+        )
         handler._json({"success": True})
 
     def _h_import_values(self, handler, index: str, field: str) -> None:
-        self.server.api.import_values(index, field, handler._json_body())
+        self._apply_or_reforward_import(
+            index, field, handler._json_body(), values=True
+        )
         handler._json({"success": True})
+
+    def _apply_or_reforward_import(
+        self, index: str, field: str, payload: dict, values: bool
+    ) -> None:
+        """Authoritative-receiver import: a node whose topology is stale
+        (e.g. mid-join) fans out to OLD owners; if this node no longer
+        owns the payload's shard, re-forward to the current owners so the
+        bits land where reads route — otherwise they'd sit invisible in a
+        relinquished fragment until the anti-entropy handoff. The
+        `reforwarded` flag stops ping-pong when two nodes disagree about
+        ownership: the second hop applies locally and lets AE reconcile."""
+        cols = payload.get("columnIDs", [])
+        shard = int(cols[0]) // SHARD_WIDTH if cols else 0
+        if (
+            not payload.get("reforwarded")
+            and cols
+            and not self.topology.owns(self.me.id, index, shard)
+        ):
+            fwd = dict(payload)
+            fwd["reforwarded"] = True
+            delivered = 0
+            for owner in self.shard_nodes(index, shard):
+                if not self._probe_alive(owner):
+                    continue
+                try:
+                    self.client.import_node(owner.uri, index, field, fwd, values)
+                    delivered += 1
+                except PeerError:
+                    continue
+            if delivered:
+                return
+            # every current owner unreachable: apply locally — the bits
+            # survive here and hand off at the next anti-entropy pass
+        if values:
+            self.server.api.import_values(index, field, payload)
+        else:
+            self.server.api.import_bits(index, field, payload)
 
     def _attr_store_from_params(self, handler):
         """Resolve the attr store named by index= [+ field=] params:

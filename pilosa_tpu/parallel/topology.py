@@ -60,6 +60,11 @@ class Node:
 class Topology:
     nodes: list[Node] = field(default_factory=list)
     replica_n: int = 1
+    # membership version: bumped by every applied add/remove. Heartbeat
+    # reconciliation adopts the HIGHER-epoch list, so both growth and
+    # shrink converge across nodes that missed a broadcast (reference:
+    # memberlist incarnation numbers serving the same role).
+    epoch: int = 0
 
     def __post_init__(self) -> None:
         self.nodes.sort(key=lambda n: n.id)
@@ -75,7 +80,21 @@ class Topology:
         list (reference: cluster.go removeNode → ResizeJob placement diff)."""
         before = len(self.nodes)
         self.nodes = [n for n in self.nodes if n.id != node_id]
-        return len(self.nodes) < before
+        if len(self.nodes) < before:
+            self.epoch += 1
+            return True
+        return False
+
+    def add(self, node: Node) -> bool:
+        """Insert a joining node (idempotent by URI); shard ownership
+        re-derives from the larger node list (reference: cluster.go
+        memberlist join → ResizeJob placement diff)."""
+        if any(n.uri == node.uri for n in self.nodes):
+            return False
+        self.nodes.append(node)
+        self.nodes.sort(key=lambda n: n.id)
+        self.epoch += 1
+        return True
 
     def partition_nodes(self, partition_id: int) -> list[Node]:
         """Replica chain for a partition: primary + next ReplicaN-1 nodes

@@ -379,6 +379,9 @@ class API:
             return [n.to_json() for n in self.cluster.nodes]
         return [{"id": "local", "uri": "", "isCoordinator": True}]
 
+    def topology_epoch(self) -> int:
+        return self.cluster.topology.epoch if self.cluster is not None else 0
+
     def shard_nodes(self, index: str, shard: int) -> list[dict]:
         if self.cluster is not None:
             return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]

@@ -338,6 +338,7 @@ class Handler(BaseHTTPRequestHandler):
                 "state": self.api.state(),
                 "nodes": self.api.hosts(),
                 "localID": self.server.node_id,
+                "topologyEpoch": self.api.topology_epoch(),
             }
         )
 

@@ -923,3 +923,194 @@ def test_topn_ids_with_n_exact(tmp_path):
         assert res == [{"id": 1, "count": 7}]
     finally:
         shutdown(servers)
+
+
+def _grow_cluster(tmp_path, servers, ports, seeds):
+    """Start one MORE node whose seeds include the existing cluster."""
+    (new_port,) = free_ports(1)
+    new_seeds = seeds + [f"http://127.0.0.1:{new_port}"]
+    cfg = Config(
+        bind=f"127.0.0.1:{new_port}",
+        data_dir=str(tmp_path / f"node{len(servers)}"),
+        seeds=new_seeds,
+        replica_n=servers[0].config.replica_n,
+        anti_entropy_interval=0,
+    )
+    s = Server(cfg)
+    s.open()
+    return s, new_port
+
+
+def test_cluster_grows_and_rebalances(tmp_path):
+    """VERDICT r3 item 3: a fresh node joining an established cluster is
+    inserted on every peer (epoch-bumped announce), pulls the shards it
+    now owns, and old owners hand off + drop relinquished fragments at
+    the next anti-entropy pass — with no lost bits."""
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        n_shards = 30
+        cols = [s * SHARD_WIDTH + 7 for s in range(n_shards)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * len(cols), "columnIDs": cols})
+        assert call(ports[0], "POST", "/index/i/query",
+                    b"Count(Row(f=1))")["results"] == [n_shards]
+
+        new_srv, new_port = _grow_cluster(tmp_path, servers, ports, seeds)
+        servers = servers + [new_srv]
+        ports = ports + [new_port]
+        for s in servers[:2]:
+            s.cluster.wait_rebalanced(30)  # old nodes pull off-thread
+
+        # every member (old and new) now lists 3 nodes at the same epoch
+        for s in servers:
+            assert len(s.cluster.topology.nodes) == 3
+        epochs = {s.cluster.topology.epoch for s in servers}
+        assert epochs == {servers[0].cluster.topology.epoch}
+        # the joiner owns a non-empty share and has pulled those fragments
+        own = [sh for sh in range(n_shards)
+               if new_srv.cluster.topology.owns(new_srv.cluster.me.id, "i", sh)]
+        assert own, "3-node placement should give the joiner some shards"
+        held = new_srv.holder.index("i").available_shards()
+        for sh in own:
+            assert sh in held, f"joiner did not pull owned shard {sh}"
+
+        # counts stay exact from every node
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [n_shards]
+
+        # anti-entropy hands off + drops relinquished fragments
+        for s in servers:
+            s.cluster.sync_holder()
+        for s in servers:
+            me = s.cluster.me.id
+            for sh in s.holder.index("i").available_shards():
+                assert s.cluster.topology.owns(me, "i", sh), (
+                    f"{me} still holds relinquished shard {sh}"
+                )
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [n_shards]
+    finally:
+        shutdown(servers)
+
+
+def test_join_announce_not_reaped_by_stale_peer(tmp_path):
+    """The round-3 hazard: a peer that missed the join announce must
+    ADOPT the joiner via the higher-epoch list at its next heartbeat —
+    never converge the cluster toward removing it."""
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    new_srv = None
+    try:
+        # simulate a missed announce: insert the joiner on node 0 only
+        (jp,) = free_ports(1)
+        servers[0].cluster.add_node("joiner", f"http://127.0.0.1:{jp}",
+                                    forward=False)
+        assert len(servers[0].cluster.topology.nodes) == 3
+        assert len(servers[1].cluster.topology.nodes) == 2
+        # node 1 heartbeats: node 0's epoch is higher -> adopt the joiner
+        servers[1].cluster._heartbeat_once()
+        assert len(servers[1].cluster.topology.nodes) == 3
+        assert servers[1].cluster.topology.epoch == \
+            servers[0].cluster.topology.epoch
+        # and crucially node 0 never reaps it back out
+        servers[0].cluster._heartbeat_once()
+        assert len(servers[0].cluster.topology.nodes) == 3
+        assert not servers[0].cluster.removed and not servers[1].cluster.removed
+    finally:
+        if new_srv is not None:
+            new_srv.close()
+        shutdown(servers)
+
+
+def test_missed_removal_still_converges_by_epoch(tmp_path):
+    """Shrink continues to reconcile under the epoch scheme: a node that
+    missed the remove broadcast adopts the higher-epoch (smaller) list."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        victim_id = servers[2].cluster.me.id
+        servers[0].cluster.remove_node(victim_id, broadcast=False)
+        assert servers[0].cluster.topology.node(victim_id) is None
+        assert servers[1].cluster.topology.node(victim_id) is not None
+        servers[1].cluster._heartbeat_once()
+        assert servers[1].cluster.topology.node(victim_id) is None
+    finally:
+        shutdown(servers)
+
+
+def test_restarted_member_relearns_grown_cluster(tmp_path):
+    """A member restarting with its ORIGINAL seed list (which predates a
+    later join) must re-adopt the grown membership from its peers, not
+    route reads across a phantom sub-cluster."""
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    new_srv = None
+    try:
+        new_srv, new_port = _grow_cluster(tmp_path, servers, ports, seeds)
+        for s in servers:
+            assert len(s.cluster.topology.nodes) == 3
+        # restart node 1 with the stale 2-node seed list
+        servers[1].close()
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[1]}",
+            data_dir=str(tmp_path / "node1"),
+            seeds=seeds,  # original two URIs only
+            replica_n=1,
+            anti_entropy_interval=0,
+        )
+        servers[1] = Server(cfg)
+        servers[1].open()
+        assert len(servers[1].cluster.topology.nodes) == 3, (
+            "restarted member did not adopt the grown membership"
+        )
+        assert not servers[1].cluster.removed
+    finally:
+        if new_srv is not None:
+            new_srv.close()
+        shutdown(servers)
+
+
+def test_member_rejoins_from_new_address(tmp_path):
+    """An announce-joined NAMED node moving to a new port must replace
+    its stale topology entry on every peer (id match, new URI) — not be
+    refused by the old entry and then self-remove on adopting a list
+    without itself. (A node peers only know by a seed-derived host:port
+    id is indistinguishable from a brand-new member when it moves; its
+    old entry is retired with an explicit remove-node, as documented.)"""
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    mover = None
+    try:
+        (p1,) = free_ports(1)
+        cfg = Config(
+            bind=f"127.0.0.1:{p1}",
+            name="mover",
+            data_dir=str(tmp_path / "mover"),
+            seeds=seeds + [f"http://127.0.0.1:{p1}"],
+            anti_entropy_interval=0,
+        )
+        mover = Server(cfg)
+        mover.open()  # announce-joins as id "mover"
+        assert {n.id for n in servers[0].cluster.topology.nodes} >= {"mover"}
+        # move: same name, new port
+        mover.close()
+        (p2,) = free_ports(1)
+        cfg = Config(
+            bind=f"127.0.0.1:{p2}",
+            name="mover",
+            data_dir=str(tmp_path / "mover"),
+            seeds=seeds + [f"http://127.0.0.1:{p2}"],
+            anti_entropy_interval=0,
+        )
+        mover = Server(cfg)
+        mover.open()
+        for s in servers:
+            uris = {n.uri for n in s.cluster.topology.nodes}
+            assert f"http://127.0.0.1:{p2}" in uris
+            assert f"http://127.0.0.1:{p1}" not in uris
+        assert not mover.cluster.removed
+        assert len(mover.cluster.topology.nodes) == 3
+    finally:
+        if mover is not None:
+            mover.close()
+        shutdown(servers)
