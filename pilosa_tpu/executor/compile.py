@@ -962,5 +962,3 @@ class QueryCompiler:
             key, prog, arrays, np.asarray(planner.scalar_values(), dtype=np.int32)
         )
 
-    def count(self, idx: Index, call: Call, shards: list[int]) -> int:
-        return int(self.count_async(idx, call, shards))

@@ -44,10 +44,12 @@ def test_groupby_level_dispatch_count(monkeypatch):
         lambda *a: (calls.__setitem__("masks", calls["masks"] + 1), orig_masks(*a))[1],
     )
     res = e.execute("g", "GroupBy(Rows(a), Rows(b))")[0]
-    # 2 levels → 2 counts dispatches + 1 masks dispatch (final level has
-    # no aggregate, so its masks are never materialized); 30×40 candidate
-    # pairs would have been ≥1200 dispatches on the r1 path
-    assert calls["counts"] == 2 and calls["masks"] == 1
+    # fused all-pairs path: ONE masks dispatch folds level 0, ONE counts
+    # dispatch covers every (a-row, b-row) pair, and the readback defers
+    # to the execute() wave; 30×40 candidate pairs would have been ≥1200
+    # dispatches on the r1 path and 2 counts + 1 masks + per-level sync
+    # readbacks on the r3 level-synchronous path
+    assert calls["counts"] == 1 and calls["masks"] == 1
     assert len(res) > 0
 
 
@@ -101,3 +103,44 @@ def test_groupby_filter():
             expect[ar] = expect.get(ar, 0) + 1
     got = {g["group"][0]["rowID"]: g["count"] for g in res}
     assert got == expect
+
+
+def test_groupby_fused_matches_level_synchronous():
+    """The fused all-pairs path (one deferred readback) and the
+    level-synchronous fallback must produce byte-identical results,
+    including nested order and limit semantics."""
+    h, cols, arows, brows, vals = _setup()
+    e = Executor(h)
+    fused = e.execute("g", "GroupBy(Rows(a), Rows(b), limit=7)")[0]
+    e2 = Executor(h)
+    e2.GROUPBY_MASK_BUDGET = 0  # any fold exceeds -> level-synchronous
+    sync = e2.execute("g", "GroupBy(Rows(a), Rows(b), limit=7)")[0]
+    assert fused == sync and len(fused) == 7
+
+
+def test_mixed_aggregate_wave_single_transfer(monkeypatch):
+    """A request mixing Count/TopN/Sum/Min/Max/GroupBy resolves every
+    deferred aggregate in ONE device→host transfer (the _Pending wave):
+    through a remote-tunnel transport each np.asarray is a full RTT, so
+    the wave count IS the latency model."""
+    import pilosa_tpu.executor.executor as ex_mod
+
+    h, cols, arows, brows, vals = _setup()
+    e = Executor(h)
+    q = ("Count(Row(a=1)) TopN(a, n=3) Sum(field=v) Min(field=v) "
+         "Max(field=v) GroupBy(Rows(a), Rows(b))")
+    expected = e.execute("g", q)
+
+    transfers = {"n": 0}
+    orig = ex_mod.np.asarray
+
+    def counting(x, *a, **k):
+        if hasattr(x, "devices"):  # jax array -> host transfer
+            transfers["n"] += 1
+        return orig(x, *a, **k)
+
+    monkeypatch.setattr(ex_mod.np, "asarray", counting)
+    got = e.execute("g", q)
+    monkeypatch.setattr(ex_mod.np, "asarray", orig)
+    assert got == expected
+    assert transfers["n"] == 1, f"expected 1 readback wave, saw {transfers['n']}"
