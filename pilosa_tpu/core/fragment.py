@@ -364,7 +364,12 @@ class Fragment:
         with self._lock:
             incoming, consumed = roaring.deserialize(data)
             roaring.replay_ops(incoming, data[consumed:])
-            self.bitmap = self.bitmap | incoming
+            if not self.bitmap._containers:
+                # fresh fragment: adopt the deserialized bitmap outright
+                # (zero-copy buffer views) — the dominant bulk-load case
+                self.bitmap = incoming
+            else:
+                self.bitmap = self.bitmap | incoming
             self.snapshot()
             self._mark_all_dirty()
 

@@ -187,6 +187,25 @@ def sort_unique_u64(values: np.ndarray, owned: bool = False) -> np.ndarray:
     return data[:n]
 
 
+def merge_unique_u64(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Union of two SORTED-UNIQUE uint64 arrays via one linear C merge —
+    the roaring union hot path, where re-radix-sorting the concatenation
+    (sort_unique_u64) costs ~8 passes over data that is already 99%
+    ordered. numpy fallback: concatenate + np.unique."""
+    lib = _load()
+    if lib is None or a.size + b.size < 2048:
+        return np.unique(np.concatenate([a, b]))
+    a = np.ascontiguousarray(a, dtype=np.uint64)
+    b = np.ascontiguousarray(b, dtype=np.uint64)
+    out = np.empty(a.size + b.size, dtype=np.uint64)
+    n = lib.u64_union(
+        _ptr(a, ctypes.c_uint64), a.size,
+        _ptr(b, ctypes.c_uint64), b.size,
+        _ptr(out, ctypes.c_uint64),
+    )
+    return out[:n]
+
+
 def counting_argsort(keys: np.ndarray) -> np.ndarray:
     """Stable argsort of small-integer uint64 keys in O(n + max_key)
     (shard grouping: keys are shard ids). Computes the key maximum

@@ -85,6 +85,7 @@ class Cluster:
         self._peer_shards: dict[tuple[str, str], set[int]] = {}
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
+        self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
         self._closed = False
 
     # ------------------------------------------------------------ membership
@@ -240,6 +241,17 @@ class Cluster:
         self._closed = True
         if self._hb_timer is not None:
             self._hb_timer.cancel()
+        if self._import_exec is not None:
+            self._import_exec.shutdown(wait=False)
+
+    def _import_pool(self):
+        if self._import_exec is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._import_exec = ThreadPoolExecutor(
+                max_workers=16, thread_name_prefix="import-fanout"
+            )
+        return self._import_exec
 
     def _peers(self, alive_only: bool = True) -> list[Node]:
         return [
@@ -1089,6 +1101,9 @@ class Cluster:
         self._known_shards.setdefault(index, set()).update(
             int(s) for s in np.unique(shards).tolist()
         )
+        local: list[tuple[int, dict]] = []
+        remote: list[tuple[int, Node, dict]] = []
+        delivered: dict[int, int] = {}
         for shard in np.unique(shards).tolist():
             m = shards == shard
             sub = dict(payload)
@@ -1107,21 +1122,41 @@ class Cluster:
                 ts = payload.get("timestamps")
                 if ts:
                     sub["timestamps"] = [ts[i] for i in np.flatnonzero(m).tolist()]
-            delivered = 0
-            for owner in self.shard_nodes(index, int(shard)):
+            sh = int(shard)
+            delivered[sh] = 0
+            for owner in self.shard_nodes(index, sh):
                 if not self._probe_alive(owner):
                     continue
                 if owner.id == self.me.id:
-                    if values:
-                        api.import_values(index, field, sub)
-                    else:
-                        api.import_bits(index, field, sub)
+                    local.append((sh, sub))
                 else:
-                    self.client.import_node(owner.uri, index, field, sub, values)
-                delivered += 1
-            if delivered == 0:
+                    remote.append((sh, owner, sub))
+        # remote shard slices fan out CONCURRENTLY (each delivery is an
+        # HTTP RPC; the round-3 sequential loop made wide imports pay
+        # sum-of-RTTs) and overlap the local applies; failures propagate
+        # exactly like the sequential path (fut.result re-raises)
+        futs = []
+        if remote:
+            pool = self._import_pool()
+            futs = [
+                (sh, pool.submit(
+                    self.client.import_node, o.uri, index, field, sub, values
+                ))
+                for sh, o, sub in remote
+            ]
+        for sh, sub in local:
+            if values:
+                api.import_values(index, field, sub)
+            else:
+                api.import_bits(index, field, sub)
+            delivered[sh] += 1
+        for sh, fut in futs:
+            fut.result()
+            delivered[sh] += 1
+        for sh, d in delivered.items():
+            if d == 0:
                 raise ShardUnavailableError(
-                    f"no alive owner for shard {int(shard)}; import rejected"
+                    f"no alive owner for shard {sh}; import rejected"
                 )
 
     # ---------------------------------------------------------- translation

@@ -377,8 +377,59 @@ class Bitmap:
         return self._zipped(other, keys, ct.container_and)
 
     def union(self, other: "Bitmap") -> "Bitmap":
-        keys = self._containers.keys() | other._containers.keys()
-        return self._zipped(other, keys, ct.container_or)
+        # Import-tuned union (fragment.import_roaring is `self | incoming`):
+        # - one-sided keys ADOPT the container by reference — payloads
+        #   are immutable (every mutator copies first), so sharing is
+        #   safe; a fresh or mostly-disjoint batch is all one-sided.
+        # - overlapping array/array pairs merge in ONE global radix
+        #   sort-unique over their key-tagged concatenation (the
+        #   add_many batch trick) instead of a union1d per container —
+        #   per-container numpy was ~16 µs × 64k containers per batch.
+        # - only bitmap/run-involved overlaps pay container_or.
+        out = Bitmap()
+        oc = out._containers
+        bc = other._containers
+        c_or, count = ct.container_or, ct.container_count
+        t_array = ct.TYPE_ARRAY
+        aa_keys: list[int] = []
+        aa_datas: list[np.ndarray] = []
+        for key, a in self._containers.items():
+            b = bc.get(key)
+            if b is None:
+                oc[key] = a
+            elif a.type == t_array and b.type == t_array:
+                aa_keys.append(key)
+                aa_datas.append(a.data)
+                aa_datas.append(b.data)
+            else:
+                c = c_or(a, b)
+                if count(c):
+                    oc[key] = c
+        for key, b in bc.items():
+            if key not in oc and key not in self._containers:
+                oc[key] = b
+        if aa_keys:
+            # both sides are per-container sorted; ordering the pairs by
+            # key makes each side's tagged concatenation globally sorted,
+            # so ONE linear C merge replaces a full radix re-sort
+            order = sorted(range(len(aa_keys)), key=aa_keys.__getitem__)
+            aa_keys = [aa_keys[i] for i in order]
+            merged = native.merge_unique_u64(
+                _tagged_concat(aa_keys, [aa_datas[2 * i] for i in order]),
+                _tagged_concat(aa_keys, [aa_datas[2 * i + 1] for i in order]),
+            )
+            mkeys = (merged >> _KEY_SHIFT).astype(np.int64)
+            muniq, mstarts = _uniq_sorted(mkeys)
+            mbounds = np.append(mstarts, mkeys.size)
+            mlows = (merged & _LOW_MASK).astype(np.uint16)
+            arr_max = ct.ARRAY_MAX
+            for j, key in enumerate(int(k) for k in muniq.tolist()):
+                chunk = mlows[mbounds[j] : mbounds[j + 1]]
+                if chunk.size > arr_max:
+                    oc[key] = ct.bitmap_container(ct._values_to_words(chunk))
+                else:
+                    oc[key] = ct.Container(t_array, chunk)
+        return out
 
     def difference(self, other: "Bitmap") -> "Bitmap":
         return self._zipped(other, self._containers.keys(), ct.container_andnot)
