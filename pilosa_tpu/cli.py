@@ -55,7 +55,10 @@ def _apply_skip_verify(args) -> None:
     if getattr(args, "tls_skip_verify", False):
         import ssl
 
-        _SSL_CTX = ssl._create_unverified_context()
+        ctx = ssl.create_default_context()
+        ctx.check_hostname = False
+        ctx.verify_mode = ssl.CERT_NONE
+        _SSL_CTX = ctx
     else:
         _SSL_CTX = None  # never inherit skip-verify from a prior invocation
 
@@ -237,7 +240,7 @@ def main(argv: list[str] | None = None) -> int:
         "--cpu-profile",
         default=None,
         metavar="FILE",
-        help="write a cProfile pstats dump of the whole run on shutdown",
+        help="write a folded-stack sampling profile (flamegraph input) on shutdown",
     )
     s.set_defaults(fn=cmd_server)
 

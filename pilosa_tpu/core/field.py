@@ -65,7 +65,18 @@ class FieldOptions:
     keys: bool = False
     min: int = 0
     max: int = 0
+    # True when min/max were EXPLICITLY provided: a field declared with
+    # range [0, 0] (only value 0 legal) must enforce it — overloading the
+    # 0/0 default as "unbounded" silently accepted any value (ADVICE r3)
+    has_range: bool = False
     no_standard_view: bool = False
+
+    def __post_init__(self) -> None:
+        # a nonzero range was always enforced (and pre-has_range on-disk
+        # metas must stay enforced after upgrade); only the explicit
+        # [0, 0] declaration needs has_range=True from the caller
+        if self.min != 0 or self.max != 0:
+            self.has_range = True
 
     def validate(self) -> None:
         if self.field_type not in (
@@ -241,11 +252,11 @@ class Field:
 
     def _check_range(self, lo: int, hi: int) -> None:
         """Reject values outside the declared [min, max] (reference:
-        field.go importValue "value out of range"). Fields created with
-        the default min = max = 0 are unbounded — depth grows with the
+        field.go importValue "value out of range"). Fields created
+        without an explicit range are unbounded — depth grows with the
         data instead."""
         o = self.options
-        if o.min == 0 and o.max == 0:
+        if not o.has_range:
             return
         if lo < o.min or hi > o.max:
             bad = lo if lo < o.min else hi

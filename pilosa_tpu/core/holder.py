@@ -96,6 +96,7 @@ class Holder:
                             "keys": f.options.keys,
                             "min": f.options.min,
                             "max": f.options.max,
+                            "hasRange": f.options.has_range,
                         },
                         "shards": sorted(f.available_shards()),
                     }

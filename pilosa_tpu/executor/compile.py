@@ -189,6 +189,10 @@ class StackCache:
         # alone would let two near-budget stacks coexist and OOM the
         # device once the budget scales to 70% of HBM
         self._bytes: dict[tuple, int] = {}
+        # projected bytes of builds in flight (admitted, not yet
+        # installed): two concurrent builders of different keys must see
+        # each other's claims or they co-allocate past the budget
+        self._reserved: dict[tuple, int] = {}
         self.resident_bytes = 0
         # observability: tests assert the write path stays incremental
         self.full_restacks = 0
@@ -211,7 +215,9 @@ class StackCache:
         if nothing evictable remains the admit proceeds anyway — the
         per-stack check already bounds any single entry."""
         budget = self.STACK_BYTES_BUDGET
-        while self.resident_bytes + need > budget:
+        while (
+            self.resident_bytes + sum(self._reserved.values()) + need > budget
+        ):
             victim = next((k for k in self._cache if k != keep), None)
             if victim is not None:
                 del self._cache[victim]
@@ -281,24 +287,34 @@ class StackCache:
                 self._cache[key] = (versions, cached[1], cached[2], view_ver)
                 self._cache.move_to_end(key)
                 return cached[1], cached[2]
+            # reserve the projection so a concurrent admit of a DIFFERENT
+            # key can't also pass eviction and co-allocate past the
+            # budget while both builds are in flight (ADVICE r3)
+            self._reserved[key] = need
         # build OUTSIDE the lock: a slow restack/upload must not convoy
         # concurrent cache-hit readers. A racing write between the version
         # snapshot and the build just means the next query sees another
         # version mismatch and applies the remainder (delta application is
         # idempotent — rows carry full contents).
-        entry = None
-        if cached is not None:
-            entry = self._try_delta(cached, view, shards, versions, view_ver)
-        if entry is None:
-            stacked, max_rows = stack_view_matrices(view, shards)
-            if self.mesh_ctx is not None:
-                dev = self.mesh_ctx.place_stack(stacked)
-            else:
-                dev = jnp.asarray(stacked)
+        try:
+            entry = None
+            if cached is not None:
+                entry = self._try_delta(cached, view, shards, versions, view_ver)
+            if entry is None:
+                stacked, max_rows = stack_view_matrices(view, shards)
+                if self.mesh_ctx is not None:
+                    dev = self.mesh_ctx.place_stack(stacked)
+                else:
+                    dev = jnp.asarray(stacked)
+                with self._lock:
+                    self.full_restacks += 1
+                entry = (versions, dev, max_rows, view_ver)
+        except BaseException:
             with self._lock:
-                self.full_restacks += 1
-            entry = (versions, dev, max_rows, view_ver)
+                self._reserved.pop(key, None)
+            raise
         with self._lock:
+            self._reserved.pop(key, None)
             # last-writer-wins install is self-healing: if a concurrent
             # builder installed a different entry, the next call re-reads
             # fragment versions and reconciles via the delta path

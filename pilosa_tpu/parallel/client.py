@@ -35,7 +35,10 @@ class InternalClient:
         if self._ssl_ctx is None:
             import ssl
 
-            self._ssl_ctx = ssl._create_unverified_context()
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+            self._ssl_ctx = ctx
         return self._ssl_ctx
 
     def _request(

@@ -60,6 +60,7 @@ def field_options_from_json(opts: dict) -> FieldOptions:
         keys=opts.get("keys", False),
         min=opts.get("min", 0),
         max=opts.get("max", 0),
+        has_range=opts.get("hasRange", "min" in opts or "max" in opts),
         no_standard_view=opts.get("noStandardView", False),
     )
 

