@@ -61,17 +61,28 @@ class AttrStore:
                     }
             jp = self._journal_path()
             if jp and os.path.exists(jp):
-                with open(jp) as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
+                with open(jp, "rb") as f:
+                    data = f.read()
+                good = 0  # bytes of fully replayed records
+                for raw in data.splitlines(keepends=True):
+                    if not raw.endswith(b"\n"):
+                        break  # torn tail from a crash mid-append
+                    line = raw.strip()
+                    if line:
                         try:
                             rec = json.loads(line)
                         except ValueError:
-                            break  # torn tail from a crash mid-append
+                            break
                         self._apply_cells(rec)
                         self._journal_ops += 1
+                    good += len(raw)
+                if good < len(data):
+                    # truncate the torn tail NOW — appending after it
+                    # would weld the next record onto the partial line,
+                    # silently discarding everything from the tear on at
+                    # the following open
+                    with open(jp, "r+b") as f:
+                        f.truncate(good)
 
     def close(self) -> None:
         pass
@@ -146,7 +157,8 @@ class AttrStore:
                 cell = [_TOMBSTONE if v is None else v, now]
                 cells[k] = cell
                 applied[k] = cell
-            self._journal({str(id_): applied})
+            if applied:
+                self._journal({str(id_): applied})
 
     def _prune_tombstones(self) -> None:
         """Drop tombstones past TTL (and then-empty IDs) so churny

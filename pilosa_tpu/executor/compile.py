@@ -191,8 +191,11 @@ class StackCache:
         self._bytes: dict[tuple, int] = {}
         # projected bytes of builds in flight (admitted, not yet
         # installed): two concurrent builders of different keys must see
-        # each other's claims or they co-allocate past the budget
-        self._reserved: dict[tuple, int] = {}
+        # each other's claims or they co-allocate past the budget.
+        # Keyed by a PER-BUILD token, not the stack key — two concurrent
+        # builds of the SAME key must each hold a claim, or the first to
+        # finish releases the second's bytes while it is still allocating
+        self._reserved: dict[object, int] = {}
         self.resident_bytes = 0
         # observability: tests assert the write path stays incremental
         self.full_restacks = 0
@@ -290,7 +293,8 @@ class StackCache:
             # reserve the projection so a concurrent admit of a DIFFERENT
             # key can't also pass eviction and co-allocate past the
             # budget while both builds are in flight (ADVICE r3)
-            self._reserved[key] = need
+            build_token = object()
+            self._reserved[build_token] = need
         # build OUTSIDE the lock: a slow restack/upload must not convoy
         # concurrent cache-hit readers. A racing write between the version
         # snapshot and the build just means the next query sees another
@@ -311,10 +315,10 @@ class StackCache:
                 entry = (versions, dev, max_rows, view_ver)
         except BaseException:
             with self._lock:
-                self._reserved.pop(key, None)
+                self._reserved.pop(build_token, None)
             raise
         with self._lock:
-            self._reserved.pop(key, None)
+            self._reserved.pop(build_token, None)
             # last-writer-wins install is self-healing: if a concurrent
             # builder installed a different entry, the next call re-reads
             # fragment versions and reconciles via the delta path
@@ -647,9 +651,17 @@ class _Planner:
             m = arrays[ai]
             row = scalars[si]
             # out-of-range / -1 rows read as zeros; axis 0 of the
-            # row-major stack — a contiguous [S, W] plane, so the gather
-            # reads only this row's bytes (see stack_view_matrices)
-            return jnp.take(m, row, axis=0, mode="fill", fill_value=0)
+            # row-major stack — a contiguous [S, W] plane, so the slice
+            # reads only this row's bytes (see stack_view_matrices).
+            # dynamic_index_in_dim + select rather than jnp.take: a
+            # scalar take lowers to a gather HLO, which XLA may
+            # materialize as its own HBM-sized temp before the consumer
+            # op; dynamic-slice fuses into the consumer (the AND/popcount
+            # chain), keeping a query's traffic at the rows it touches.
+            r = jnp.clip(row, 0, m.shape[0] - 1)
+            plane = jax.lax.dynamic_index_in_dim(m, r, axis=0, keepdims=False)
+            valid = (row >= 0) & (row < m.shape[0])
+            return jnp.where(valid, plane, jnp.uint32(0))
 
         return run, f"row({mode}:{field.name}/{view_name})"
 

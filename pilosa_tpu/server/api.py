@@ -49,9 +49,22 @@ def validate_name(name: str, what: str = "name") -> str:
     return name
 
 
-def field_options_from_json(opts: dict) -> FieldOptions:
+def field_options_from_json(opts: dict, explicit_create: bool = False) -> FieldOptions:
     """Map the reference's JSON field-options wire names onto FieldOptions
-    (reference: http/handler.go postFieldRequest)."""
+    (reference: http/handler.go postFieldRequest).
+
+    Range tracking: an explicit ``hasRange`` always wins. Without it, the
+    CREATE route treats a present min/max key as a declared range (so an
+    operator's explicit [0, 0] is enforced), but schema RESTORES/sync use
+    the nonzero rule — pre-hasRange /schema dumps serialize min:0/max:0
+    unconditionally for unbounded fields, and reading those as an
+    enforced [0, 0] would brick every restored int field."""
+    if "hasRange" in opts:
+        has_range = bool(opts["hasRange"])
+    elif explicit_create:
+        has_range = "min" in opts or "max" in opts
+    else:
+        has_range = bool(opts.get("min", 0) or opts.get("max", 0))
     return FieldOptions(
         field_type=opts.get("type", "set"),
         cache_type=opts.get("cacheType", "ranked"),
@@ -60,7 +73,7 @@ def field_options_from_json(opts: dict) -> FieldOptions:
         keys=opts.get("keys", False),
         min=opts.get("min", 0),
         max=opts.get("max", 0),
-        has_range=opts.get("hasRange", "min" in opts or "max" in opts),
+        has_range=has_range,
         no_standard_view=opts.get("noStandardView", False),
     )
 
@@ -117,7 +130,9 @@ class API:
     def create_field(self, index: str, name: str, options: dict | None = None) -> Field:
         validate_name(name, "field name")
         idx = self._index(index)
-        return idx.create_field(name, field_options_from_json(options or {}))
+        return idx.create_field(
+            name, field_options_from_json(options or {}, explicit_create=True)
+        )
 
     def delete_field(self, index: str, name: str) -> None:
         self._index(index).delete_field(name)

@@ -278,3 +278,14 @@ def test_explicit_zero_range_enforced(srv):
     call(srv, "POST", "/index/zr/field/u", {"options": {"type": "int"}})
     call(srv, "POST", "/index/zr/field/u/import-value",
          {"columnIDs": [1], "values": [123456]})
+
+
+def test_old_schema_dump_restores_unbounded(srv):
+    """Pre-hasRange /schema dumps serialize min:0/max:0 for unbounded
+    int fields; restoring one must NOT enforce a [0, 0] range."""
+    call(srv, "POST", "/schema", {"indexes": [{
+        "name": "restored",
+        "fields": [{"name": "v", "options": {"type": "int", "min": 0, "max": 0}}],
+    }]})
+    call(srv, "POST", "/index/restored/field/v/import-value",
+         {"columnIDs": [1], "values": [999]})  # would 400 if [0,0] enforced
