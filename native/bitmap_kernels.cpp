@@ -164,6 +164,26 @@ int64_t u64_sort_unique(uint64_t* data, int64_t n, uint64_t* tmp) {
     return k;
 }
 
+// Fill one row-plane range of a stacked [R, S, W] uint32 matrix from
+// per-shard [R_i, W] source matrices (srcs[i] may be null ⇒ zeros,
+// already zeroed by the caller). Rows r0..r1 exclusive; the caller
+// shards the row range across threads — each thread writes disjoint
+// [S, W] planes, so no synchronization is needed.
+void u32_stack_fill(const uint32_t** srcs, const int64_t* src_rows,
+                    int64_t n_shards, int64_t words, uint32_t* dst,
+                    int64_t r0, int64_t r1) {
+    const int64_t plane = n_shards * words;
+    for (int64_t r = r0; r < r1; ++r) {
+        uint32_t* out = dst + r * plane;
+        for (int64_t i = 0; i < n_shards; ++i) {
+            if (srcs[i] != nullptr && r < src_rows[i]) {
+                std::memcpy(out + i * words, srcs[i] + r * words,
+                            (size_t)words * 4);
+            }
+        }
+    }
+}
+
 // Stable counting argsort for small integer keys (max_key bounded):
 // O(n + max_key). ``counts`` must hold max_key + 1 zeroed slots.
 void u64_counting_argsort(const uint64_t* keys, int64_t n, int64_t max_key,

@@ -97,15 +97,21 @@ def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
             mats.append(m)
             max_rows = max(max_rows, m.shape[0])
     stacked = np.zeros((max_rows, len(shards), WORDS_PER_SHARD), dtype=np.uint32)
-    # rows outer, shards inner: destination writes land contiguously in
-    # each [S, W] row plane. Controlled A/B at 10 GiB on the bench host
-    # (fresh destinations, alternating reps): shard-inner strided fill
-    # 44.2/23.7 s vs this order 20.2/11.7 s — consistently ~2× faster
-    for r in range(max_rows):
-        plane = stacked[r]
-        for i, m in enumerate(mats):
-            if m is not None and r < m.shape[0]:
-                plane[i] = m[r]
+    from pilosa_tpu import native
+
+    if not native.stack_fill(mats, stacked):
+        # numpy fallback — rows outer, shards inner: destination writes
+        # land contiguously in each [S, W] row plane. Controlled A/B at
+        # 10 GiB on the bench host (fresh destinations, alternating
+        # reps): shard-inner strided fill 44.2/23.7 s vs this order
+        # 20.2/11.7 s — consistently ~2× faster. The C path above
+        # parallelizes the same row-plane order across threads (ctypes
+        # releases the GIL), cutting the pod-scale stack build further.
+        for r in range(max_rows):
+            plane = stacked[r]
+            for i, m in enumerate(mats):
+                if m is not None and r < m.shape[0]:
+                    plane[i] = m[r]
     return stacked, max_rows
 
 
