@@ -379,6 +379,16 @@ class Cluster:
             node.alive = False
         return node.alive
 
+    def _node_has_shard(self, node: Node, index: str, shard: int) -> bool:
+        """Best-effort 'does this node hold the fragment': local holder
+        truth for self; the last-reported inventory (global_shards cache)
+        for peers. Unknown peers report False — routing then falls back
+        to the plain owner order, i.e. exactly the old behavior."""
+        if node.id == self.me.id:
+            idx = self.server.holder.index(index)
+            return idx is not None and shard in idx.available_shards()
+        return shard in self._peer_shards.get((node.id, index), ())
+
     def _alive_for_read(self, node: Node) -> bool:
         """Heartbeat-state liveness for READ routing — no synchronous
         probe, so one dead peer cannot add probe timeouts to every read
@@ -616,12 +626,32 @@ class Cluster:
         by_node: dict[str, list[int]] = {}
         node_by_id = {n.id: n for n in self.nodes}
         for s in all_shards:
+            alive_owners = [
+                n for n in self.shard_nodes(index, s) if self._alive_for_read(n)
+            ]
+            if not alive_owners:
+                raise ShardUnavailableError(f"no alive owner for shard {s}")
+            # Prefer an owner that actually HOLDS the fragment: mid-resize
+            # a shard's new owner may still be pulling, and routing there
+            # would silently count zeros. The previous holder keeps its
+            # copy until the anti-entropy handoff completes, so falling
+            # back to ANY alive node reporting the shard serves exact
+            # data through the window (reference: ResizeJob serves from
+            # the old assignment until the job completes).
             primary = next(
-                (n for n in self.shard_nodes(index, s) if self._alive_for_read(n)),
+                (n for n in alive_owners if self._node_has_shard(n, index, s)),
                 None,
             )
             if primary is None:
-                raise ShardUnavailableError(f"no alive owner for shard {s}")
+                primary = next(
+                    (
+                        n
+                        for n in self.nodes
+                        if self._alive_for_read(n)
+                        and self._node_has_shard(n, index, s)
+                    ),
+                    alive_owners[0],
+                )
             by_node.setdefault(primary.id, []).append(s)
 
         send = call

@@ -1114,3 +1114,61 @@ def test_member_rejoins_from_new_address(tmp_path):
         if mover is not None:
             mover.close()
         shutdown(servers)
+
+
+def test_reads_exact_during_resize_window(tmp_path, monkeypatch):
+    """Mid-growth, a shard's new owner may not have pulled its fragment
+    yet — reads must route to a node still HOLDING the data (the old
+    owner keeps its copy until the AE handoff), not count zeros."""
+    import threading
+
+    from pilosa_tpu.parallel.cluster import Cluster
+
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    new_holder = [None]
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        n_shards = 30
+        cols = [s * SHARD_WIDTH + 7 for s in range(n_shards)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * n_shards, "columnIDs": cols})
+
+        gate = threading.Event()
+        orig = Cluster._pull_owned_fragments
+
+        def gated(self, sources):
+            gate.wait(30)
+            return orig(self, sources)
+
+        monkeypatch.setattr(Cluster, "_pull_owned_fragments", gated)
+
+        def start_third():
+            new_holder[0] = _grow_cluster(tmp_path, servers, ports, seeds)
+
+        t = threading.Thread(target=start_third, daemon=True)
+        t.start()
+        # wait until both old nodes know the 3-node topology (announce
+        # lands before any data moves — the pulls are gated)
+        deadline = __import__("time").time() + 20
+        while __import__("time").time() < deadline:
+            if all(len(s.cluster.topology.nodes) == 3 for s in servers):
+                break
+            __import__("time").sleep(0.05)
+        assert all(len(s.cluster.topology.nodes) == 3 for s in servers)
+        # reads during the window: every shard still counts exactly
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [n_shards]
+        gate.set()
+        t.join(timeout=60)
+        assert new_holder[0] is not None
+        new_srv, new_port = new_holder[0]
+        servers.append(new_srv)
+        for s in servers[:2]:
+            s.cluster.wait_rebalanced(30)
+        for p in ports + [new_port]:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [n_shards]
+    finally:
+        shutdown(servers)
