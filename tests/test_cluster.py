@@ -1253,3 +1253,107 @@ def test_cluster_vs_single_node_oracle_fuzz(tmp_path):
                 assert got == norm, f"{q}: cluster {got} != oracle {norm}"
     finally:
         shutdown(servers)
+
+
+def test_cluster_grows_with_replication(tmp_path):
+    """Growth under replica_n=2: replica chains reshuffle broadly
+    (partition % n indexing); after rebalance + AE every shard is held
+    by BOTH of its owners and counts stay exact."""
+    servers, ports, seeds = make_cluster(tmp_path, n=2, replica_n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        n_shards = 20
+        cols = [s * SHARD_WIDTH + 3 for s in range(n_shards)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * n_shards, "columnIDs": cols})
+
+        new_srv, new_port = _grow_cluster(tmp_path, servers, ports, seeds)
+        servers = servers + [new_srv]
+        ports = ports + [new_port]
+        for s in servers[:2]:
+            s.cluster.wait_rebalanced(30)
+        for s in servers:
+            s.cluster.sync_holder()
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [n_shards]
+        # replication invariant: every owner holds every shard it owns
+        by_id = {s.cluster.me.id: s for s in servers}
+        for sh in range(n_shards):
+            owners = servers[0].cluster.shard_nodes("i", sh)
+            assert len(owners) == 2
+            for o in owners:
+                held = by_id[o.id].holder.index("i").available_shards()
+                assert sh in held, f"owner {o.id} missing shard {sh}"
+    finally:
+        shutdown(servers)
+
+
+def test_cluster_stress_mixed_load(tmp_path):
+    """Short mixed-load stress over the new concurrent machinery
+    (threaded import fan-out, rebalance threads, AE handoff, pipelined
+    reads): writers + readers + manual AE passes race for a few seconds,
+    then the final count must equal exactly the acked writes."""
+    import threading
+    import time as _time
+
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        stop = threading.Event()
+        acked: list[int] = []
+        errors: list[str] = []
+
+        def writer(tid):
+            k = 0
+            while not stop.is_set():
+                col = (k % 16) * SHARD_WIDTH + tid * 10_000 + k // 16
+                try:
+                    call(ports[k % 2], "POST", "/index/i/field/f/import",
+                         {"rowIDs": [1], "columnIDs": [col]})
+                    acked.append(col)
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"write: {e}")
+                k += 1
+
+        def reader():
+            last = 0
+            while not stop.is_set():
+                try:
+                    got = call(ports[0], "POST", "/index/i/query",
+                               b"Count(Row(f=1))")["results"][0]
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"read: {e}")
+                    continue
+                if got < last:
+                    errors.append(f"count went backwards: {last} -> {got}")
+                last = got
+
+        def syncer():
+            while not stop.is_set():
+                try:
+                    servers[1].cluster.sync_holder()
+                except Exception as e:  # noqa: BLE001
+                    errors.append(f"sync: {e}")
+                _time.sleep(0.3)
+
+        threads = [threading.Thread(target=writer, args=(t,), daemon=True)
+                   for t in range(3)]
+        threads += [threading.Thread(target=reader, daemon=True),
+                    threading.Thread(target=syncer, daemon=True)]
+        for t in threads:
+            t.start()
+        _time.sleep(4.0)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:5]
+        expect = len(set(acked))
+        assert expect > 50, "stress made no progress"
+        for p in ports:
+            assert call(p, "POST", "/index/i/query",
+                        b"Count(Row(f=1))")["results"] == [expect]
+    finally:
+        shutdown(servers)
