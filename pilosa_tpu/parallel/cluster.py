@@ -118,6 +118,12 @@ class Cluster:
         self._announce_if_new()
         self._heartbeat_once()
         self._recover_on_join()
+        # inventories refresh AFTER the schema pull: the heartbeat above
+        # ran with an empty holder (no indexes yet), so without this a
+        # just-(re)started node would serve reads from only its local
+        # shards until the next heartbeat tick
+        for n in self._peers():
+            self._refresh_peer_shards(n)
         self.state = STATE_NORMAL
         self._schedule_heartbeat()
 
@@ -279,6 +285,7 @@ class Cluster:
                 n.alive = False
                 degraded = True
                 continue
+            self._apply_status_inventory(n, st)
             ep = st.get("topologyEpoch")
             peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
             if not isinstance(ep, int) or not peer_nodes:
@@ -452,6 +459,15 @@ class Cluster:
                             )
                     except PeerError:
                         continue
+        # the pull changed this node's holdings: publish the new
+        # inventory so cached read routing points here without waiting
+        # for the next heartbeat refresh
+        for idx_name, idx_obj in list(self.server.holder.indexes.items()):
+            self._announce_shards(
+                idx_name,
+                {self.me.uri: sorted(idx_obj.available_shards())},
+                replace=True,
+            )
 
     def _resolve_node(self, ident: str, uri: str | None = None) -> Node | None:
         """Find a topology node by id or URI. Ids are config-dependent
@@ -537,7 +553,7 @@ class Cluster:
         broadcast.go DeleteIndexMessage/DeleteFieldMessage; apply_schema is
         additive so deletions need their own message)."""
         if field is None:
-            self._known_shards.pop(index, None)
+            self._purge_shard_caches(index)
         for n in self._peers(alive_only=False):
             try:
                 self.client._json(
@@ -552,30 +568,91 @@ class Cluster:
 
     # ----------------------------------------------------------- shard scan
     def global_shards(self, index: str) -> list[int]:
-        """Union of shards across live peers, merged into a monotone
-        known-shards cache. Liveness comes from heartbeat state — a dead
-        peer must not add a probe timeout to every uncached scan (VERDICT
-        r2 item 7). Partial-result safety is preserved downstream: shards
-        already in the cache keep their owner mapping, and a shard whose
+        """Union of local shards + cached peer inventories, merged into a
+        monotone known-shards cache. ZERO RPCs on the read path: peer
+        inventories arrive via synchronous shard ANNOUNCES on every
+        transition (router imports creating shards, rebalance-pull
+        completion, anti-entropy handoff drops) and ride the heartbeat
+        /status exchange — the old per-read node_shards scan put one
+        peer RTT per peer under every read (reference analogue:
+        availableShards travels in gossip/ClusterStatus, reads never
+        poll). Partial-result safety is preserved downstream: a dead
+        peer's cached shards still enter the scan, and a shard whose
         only owners are dead raises ShardUnavailableError at routing."""
         idx = self.server.holder.index(index)
         shards: set[int] = set(idx.available_shards()) if idx else set()
         for n in self._peers(alive_only=False):
-            if not self._alive_for_read(n):
-                # dead peer: count its last-reported shards anyway so its
-                # exclusively-owned shards reach routing (which then
-                # errors or serves a replica) instead of vanishing
-                shards.update(self._peer_shards.get((n.id, index), set()))
-                continue
+            shards |= self._peer_shards.get((n.id, index), set())
+        merged = self._known_shards.get(index, set()) | shards
+        self._known_shards[index] = merged  # assignment: lock-free readers
+        return sorted(merged)
+
+    def _purge_shard_caches(self, index: str) -> None:
+        """Deleting an index must drop BOTH shard caches on this node:
+        the monotone known-shards cache would otherwise resurrect ghost
+        shards from stale _peer_shards entries when an index is recreated
+        under the same name — and reads would fan out to shards that
+        never existed."""
+        self._known_shards.pop(index, None)
+        for key in [k for k in self._peer_shards if k[1] == index]:
+            self._peer_shards.pop(key, None)
+
+    def _apply_status_inventory(self, node: Node, st: dict) -> None:
+        """Adopt the full per-index inventory a /status response carries
+        (heartbeat-time repair for any announce either side missed).
+        Whole-set ASSIGNMENT, never in-place mutation — concurrent reads
+        iterate these sets lock-free."""
+        inv = st.get("shards")
+        if not isinstance(inv, dict):
+            return
+        for idx_name, sh in inv.items():
+            self._peer_shards[(node.id, idx_name)] = set(sh)
+
+    def _refresh_peer_shards(self, node: Node) -> None:
+        """One status round-trip to re-pull a peer's inventory."""
+        try:
+            st = self.client.status(node.uri, timeout=5.0)
+        except PeerError:
+            return
+        self._apply_status_inventory(node, st)
+
+    def _announce_shards(
+        self, index: str, entries: dict[str, list[int]], replace: bool = False
+    ) -> None:
+        """Tell every peer which nodes (by URI) now hold which shards of
+        an index, and apply the same update locally. ``replace`` swaps
+        the node's whole inventory (pull/handoff transitions); otherwise
+        shards accumulate (imports). A failed send self-repairs at the
+        peer's next heartbeat refresh."""
+        payload: dict = {"index": index, "entries": entries}
+        if replace:
+            payload["replace"] = True
+        self._apply_shard_entries(payload)
+        for n in self._peers():
             try:
-                reported = set(self.client.node_shards(n.uri, index))
-                self._peer_shards[(n.id, index)] = reported
-                shards.update(reported)
+                self.client._json(
+                    "POST", n.uri, "/internal/shards/announce", payload
+                )
             except PeerError:
-                shards.update(self._peer_shards.get((n.id, index), set()))
-        known = self._known_shards.setdefault(index, set())
-        known.update(shards)
-        return sorted(known)
+                pass
+
+    def _apply_shard_entries(self, payload: dict) -> None:
+        # whole-set ASSIGNMENT only (never .update in place): this runs
+        # on the HTTP handler thread while concurrent reads iterate the
+        # same sets lock-free — set replacement is atomic, mutation isn't
+        index = payload["index"]
+        for uri, sh in payload.get("entries", {}).items():
+            node = next((x for x in self.nodes if x.uri == uri), None)
+            if node is None or node.id == self.me.id:
+                continue  # local truth comes from the holder
+            key = (node.id, index)
+            if payload.get("replace"):
+                self._peer_shards[key] = set(sh)
+            else:
+                self._peer_shards[key] = self._peer_shards.get(key, set()) | set(sh)
+        self._known_shards[index] = self._known_shards.get(index, set()) | {
+            s for sh in payload.get("entries", {}).values() for s in sh
+        }
 
     # -------------------------------------------------------------- queries
     def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
@@ -1008,8 +1085,9 @@ class Cluster:
                 new_args[fname] = row_id
                 call = Call(call.name, new_args, list(call.children), list(call.pos_args))
             shard = col_id // SHARD_WIDTH
-            self._known_shards.setdefault(index, set()).add(shard)
+            is_new = shard not in self._known_shards.get(index, set())
             result = None
+            took_write: list[str] = []
             for owner in self.shard_nodes(index, shard):
                 if not self._probe_alive(owner):
                     continue
@@ -1019,9 +1097,20 @@ class Cluster:
                     r = decode_result(
                         self.client.query_node(owner.uri, index, call.to_pql(), [shard])[0]
                     )
+                took_write.append(owner.uri)
                 result = r if result is None else result
             if result is None:
                 raise ShardUnavailableError(f"no alive owner for shard {shard}")
+            # known/announced only after the write landed (a failed
+            # attempt must not suppress the announce on retry), and only
+            # naming owners that actually took it
+            self._known_shards[index] = self._known_shards.get(index, set()) | {
+                shard
+            }
+            if is_new:
+                self._announce_shards(
+                    index, {uri: [shard] for uri in took_write}
+                )
             return result
         # broadcast writes
         result: Any = None
@@ -1128,12 +1217,18 @@ class Cluster:
             ]
         cols = np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
         shards = cols // np.uint64(SHARD_WIDTH)
-        self._known_shards.setdefault(index, set()).update(
-            int(s) for s in np.unique(shards).tolist()
-        )
+        # shards become "known" (and get announced) only AFTER successful
+        # delivery — marking them early would make a failed attempt
+        # permanently suppress the announce on the client's retry
+        new_shards = [
+            int(s)
+            for s in np.unique(shards).tolist()
+            if int(s) not in self._known_shards.get(index, set())
+        ]
         local: list[tuple[int, dict]] = []
         remote: list[tuple[int, Node, dict]] = []
         delivered: dict[int, int] = {}
+        took_write: dict[int, list[str]] = {}  # shard → owner URIs that got it
         for shard in np.unique(shards).tolist():
             m = shards == shard
             sub = dict(payload)
@@ -1180,14 +1275,33 @@ class Cluster:
             else:
                 api.import_bits(index, field, sub)
             delivered[sh] += 1
+            took_write.setdefault(sh, []).append(self.me.uri)
         for sh, fut in futs:
             fut.result()
             delivered[sh] += 1
+        for sh, o, _sub in remote:
+            took_write.setdefault(sh, []).append(o.uri)
         for sh, d in delivered.items():
             if d == 0:
                 raise ShardUnavailableError(
                     f"no alive owner for shard {sh}; import rejected"
                 )
+        self._known_shards[index] = self._known_shards.get(index, set()) | {
+            int(s) for s in np.unique(shards).tolist()
+        }
+        if new_shards:
+            # synchronous announce BEFORE acking the import: a client may
+            # import through this node and immediately read through any
+            # other — peers' cached inventories must already name the new
+            # shards' owners (read-your-writes; reads make no RPCs).
+            # Entries list ONLY owners that actually took the write — a
+            # dead owner the fan-out skipped must not be advertised as a
+            # holder, or reads routed there would miss the data
+            entries: dict[str, list[int]] = {}
+            for sh in new_shards:
+                for uri in took_write.get(sh, []):
+                    entries.setdefault(uri, []).append(sh)
+            self._announce_shards(index, entries)
 
     # ---------------------------------------------------------- translation
     def _route_translate_keys(
@@ -1274,6 +1388,7 @@ class Cluster:
         (reference: holderSyncer.SyncHolder), then tail key translations
         from the primary."""
         holder = self.server.holder
+        dropped_indexes: set[str] = set()
         for idx_name, idx in list(holder.indexes.items()):
             for f_name, f in list(idx.fields.items()):
                 for v_name, view in list(f.views.items()):
@@ -1285,9 +1400,10 @@ class Cluster:
                             # owner, then dropped — writes that raced the
                             # topology change onto the old owner are
                             # preserved by the union merge
-                            self._handoff_fragment(
+                            if self._handoff_fragment(
                                 idx_name, f_name, v_name, shard, frag, view, owners
-                            )
+                            ):
+                                dropped_indexes.add(idx_name)
                             continue
                         for owner in owners:
                             if owner.id == self.me.id or not owner.alive:
@@ -1299,34 +1415,44 @@ class Cluster:
                             except PeerError:
                                 continue
             self._sync_attr_stores(idx_name, idx)
+        for idx_name in dropped_indexes:
+            # relinquished fragments left this node: re-publish the
+            # shrunken inventory so cached routing stops pointing here
+            idx = holder.index(idx_name)
+            self._announce_shards(
+                idx_name,
+                {self.me.uri: sorted(idx.available_shards()) if idx else []},
+                replace=True,
+            )
         self._tail_translations()
 
     def _handoff_fragment(
         self, index, field, view_name, shard, frag, view, owners: list[Node]
-    ) -> None:
+    ) -> bool:
         """Relinquish a no-longer-owned fragment (the drop half of the
         reference's ResizeJob): union-merge its bits into EVERY current
         owner, and delete the local copy only when all owners took the
-        push — a dead owner keeps the copy alive for the next pass."""
+        push — a dead owner keeps the copy alive for the next pass.
+        Returns True when the local copy was dropped."""
         if not owners:
-            return  # no current owners (shouldn't happen); keep the data
+            return False  # no current owners (shouldn't happen); keep the data
         v0 = frag.version
         data = serialize(frag.bitmap)
         for owner in owners:
             if not self._probe_alive(owner):
-                return
+                return False
             try:
                 self.client.import_roaring(
                     owner.uri, index, field, view_name, shard, data
                 )
             except PeerError:
-                return
+                return False
         if frag.version != v0:
             # a write raced in after the serialize — its bits aren't in
             # what we pushed, so keep the copy; the next anti-entropy
             # pass re-pushes and retires it
-            return
-        view.remove_fragment(shard)
+            return False
+        return view.remove_fragment(shard)
 
     def _sync_attr_stores(self, idx_name: str, idx) -> None:
         """Block-checksum diff of the column/row attr stores against all
@@ -1452,6 +1578,10 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/cluster/join$"),
             ): self._h_join,
+            (
+                "POST",
+                re.compile(r"^/internal/shards/announce$"),
+            ): self._h_shards_announce,
         }
         http.extra_routes.update(routes)
 
@@ -1462,6 +1592,10 @@ class Cluster:
             body["index"], body["query"], shards=body.get("shards")
         )
         handler._json({"results": [encode_result(r) for r in results]})
+
+    def _h_shards_announce(self, handler) -> None:
+        self._apply_shard_entries(handler._json_body())
+        handler._json({"success": True})
 
     def _h_shards(self, handler) -> None:
         index = handler.query_params["index"][0]
@@ -1515,7 +1649,7 @@ class Cluster:
             if field:
                 self.server.api.delete_field(index, field)
             else:
-                self._known_shards.pop(index, None)
+                self._purge_shard_caches(index)
                 self.server.api.delete_index(index)
         except (KeyError, ExecutionError):
             pass  # already gone — deletion is idempotent cluster-wide

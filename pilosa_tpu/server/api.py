@@ -398,6 +398,12 @@ class API:
     def topology_epoch(self) -> int:
         return self.cluster.topology.epoch if self.cluster is not None else 0
 
+    def node_inventories(self) -> dict:
+        return {
+            name: sorted(idx.available_shards())
+            for name, idx in self.holder.indexes.items()
+        }
+
     def shard_nodes(self, index: str, shard: int) -> list[dict]:
         if self.cluster is not None:
             return [n.to_json() for n in self.cluster.shard_nodes(index, shard)]

@@ -348,6 +348,11 @@ class Handler(BaseHTTPRequestHandler):
                 "nodes": self.api.hosts(),
                 "localID": self.server.node_id,
                 "topologyEpoch": self.api.topology_epoch(),
+                # full per-index shard inventory piggybacks on the
+                # heartbeat (reference: availableShards travels in
+                # gossip ClusterStatus) — peers route reads from this
+                # cache instead of polling node_shards per read
+                "shards": self.api.node_inventories(),
             }
         )
 
