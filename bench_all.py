@@ -346,6 +346,91 @@ def config6_ingest():
     )
 
 
+def config7_cluster_read():
+    """2-node in-process cluster over real HTTP sockets: distributed
+    read QPS (scatter-gather + reduce) vs the same data served
+    single-node. Reads route from cached shard inventories — zero
+    per-read internal RPCs — so the distributed penalty is one local
+    HTTP hop + the per-node partial merge."""
+    import socket
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils.config import Config
+
+    def free_ports(k):
+        socks = [socket.socket() for _ in range(k)]
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        ports = [s.getsockname()[1] for s in socks]
+        for s in socks:
+            s.close()
+        return ports
+
+    def call(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/c/query", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+    tmp = tempfile.mkdtemp()
+    n_shards = 8
+    rng = np.random.default_rng(7)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, 50_000).tolist()
+    rows = rng.integers(0, 4, 50_000).tolist()
+
+    def build(n_nodes, tag):
+        ports = free_ports(n_nodes)
+        seeds = [f"http://127.0.0.1:{p}" for p in ports]
+        servers = []
+        for i, p in enumerate(ports):
+            cfg = Config(
+                bind=f"127.0.0.1:{p}",
+                data_dir=f"{tmp}/{tag}{i}",
+                seeds=seeds if n_nodes > 1 else [],
+                anti_entropy_interval=0,
+                coordinator=(i == 0),
+            )
+            s = Server(cfg)
+            s.open()
+            servers.append(s)
+        post(ports[0], "/index/c", {})
+        post(ports[0], "/index/c/field/f", {})
+        for lo in range(0, len(cols), 4000):
+            post(ports[0], "/index/c/field/f/import",
+                 {"rowIDs": rows[lo:lo + 4000], "columnIDs": cols[lo:lo + 4000]})
+        return servers, ports
+
+    q = b"Count(Intersect(Row(f=1), Row(f=2)))"
+    single, sports = build(1, "s")
+    try:
+        expect = call(sports[0], q)["results"][0]
+        t_single = timeit(lambda: call(sports[0], q), 30)
+    finally:
+        for s in single:
+            s.close()
+    cluster, cports = build(2, "c")
+    try:
+        got = call(cports[0], q)["results"][0]
+        assert got == expect, (got, expect)
+        t_cluster = timeit(lambda: call(cports[0], q), 30)
+    finally:
+        for s in cluster:
+            s.close()
+    line("cluster_read_qps_2node", 1 / t_cluster, "qps", t_single / t_cluster)
+
+
 def transport_context():
     """First line of the artifact: the sync dispatch+readback RTT floor.
     On a tunneled (remote) accelerator every SYNC query pays this
@@ -380,6 +465,7 @@ def main():
         config4_bsi_sum_range,
         config5_tanimoto,
         config6_ingest,
+        config7_cluster_read,
     ):
         cfg()
 
