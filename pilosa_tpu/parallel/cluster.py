@@ -868,7 +868,11 @@ class Cluster:
         # in one or two rounds; only a genuinely flat distribution — where
         # no candidate list can prove anything — pays the exhaustive pass
         headroom_n = 2 * n + 10
-        for _ in range(3):
+        # up to 5 rounds (256× the original headroom) before the
+        # exhaustive pass: each round is two bounded RPCs, while the
+        # exhaustive fallback ships every nonzero row — worth avoiding
+        # on high-cardinality fields whenever the bound can converge
+        for _ in range(5):
             headroom = {**call.args, "n": headroom_n}
             phase1 = self._fanout(
                 index,
