@@ -345,8 +345,23 @@ class Cluster:
         if grew:
             # placement reshuffles on growth (partition % n): pull any
             # shards this node NOW owns but doesn't hold; fragments we no
-            # longer own hand off at the next anti-entropy pass
-            self._pull_owned_fragments(self._peers())
+            # longer own hand off at the next anti-entropy pass. OFF the
+            # heartbeat thread — a synchronous pull would block liveness
+            # ticks for the whole transfer; reads stay exact through the
+            # window via holder-preferring routing.
+            def rebalance():
+                prev_state, self.state = self.state, STATE_RESIZING
+                try:
+                    self._pull_owned_fragments(self._peers())
+                finally:
+                    if self.state == STATE_RESIZING:
+                        self.state = prev_state
+
+            t = threading.Thread(
+                target=rebalance, daemon=True, name="adopt-rebalance"
+            )
+            self._rebalance_thread = t
+            t.start()
 
     def _schedule_heartbeat(self) -> None:
         if self._closed:
