@@ -104,11 +104,15 @@ class InternalClient:
     # ------------------------------------------------------------ imports
     def import_node(
         self, uri: str, index: str, field: str, payload: dict, values: bool
-    ) -> None:
+    ) -> list[str]:
+        """Deliver one shard slice; returns the URIs that APPLIED it (the
+        receiver may have re-forwarded to the current owners)."""
         kind = "import-value" if values else "import"
-        self._json(
+        resp = self._json(
             "POST", uri, f"/internal/{kind}/{index}/{field}", payload
         )
+        applied = resp.get("appliedBy") if isinstance(resp, dict) else None
+        return applied if isinstance(applied, list) else [uri]
 
     def import_roaring(
         self, uri: str, index: str, field: str, view: str, shard: int, data: bytes

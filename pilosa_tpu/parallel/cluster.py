@@ -86,6 +86,7 @@ class Cluster:
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
+        self._import_exec_lock = threading.Lock()
         self._closed = False
 
     # ------------------------------------------------------------ membership
@@ -136,21 +137,43 @@ class Cluster:
         (it adopts the higher-epoch list instead). Afterwards adopt the
         freshest peer list so a single-seed join still learns the full
         membership before pulling its shards."""
+        # ONE status sweep serves the membership check, the announce
+        # decision, AND the best-epoch adoption (each /status already
+        # carries nodes + epoch + shard inventories)
+        statuses: list[tuple[Node, dict]] = []
         for n in self._peers():
             try:
                 st = self.client.status(n.uri, timeout=5.0)
             except PeerError:
                 continue
+            statuses.append((n, st))
             uris = {d.get("uri") for d in st.get("nodes", [])}
             if self.me.uri in uris:
                 continue
             try:
-                self.client._json(
+                resp = self.client._json(
                     "POST",
                     n.uri,
                     "/internal/cluster/join",
                     {"id": self.me.id, "uri": self.me.uri},
                 )
+                # the join just bumped the peer's epoch past its snapshot
+                # AND inserted us into its list — patch both, or adopting
+                # the stale (pre-join) list at the new epoch would read
+                # ourselves as removed
+                ep = resp.get("topologyEpoch")
+                if isinstance(ep, int):
+                    st = dict(st)
+                    st["topologyEpoch"] = ep
+                    # mirror the peer's add_node: it retired any stale
+                    # same-id entry (we moved) before inserting us
+                    st["nodes"] = [
+                        d
+                        for d in st.get("nodes", [])
+                        if d.get("id") != self.me.id
+                        and d.get("uri") != self.me.uri
+                    ] + [self.me.to_json()]
+                    statuses[-1] = (n, st)
             except PeerError:
                 continue
         # Adopt the freshest peer list OUTRIGHT (>=, not >): whether we
@@ -161,11 +184,7 @@ class Cluster:
         # sync epochs in heartbeats but never learn the joined nodes —
         # and route reads across a phantom sub-cluster.
         best: tuple[int, list[dict]] | None = None
-        for n in self._peers():
-            try:
-                st = self.client.status(n.uri, timeout=5.0)
-            except PeerError:
-                continue
+        for _n, st in statuses:
             ep = st.get("topologyEpoch")
             peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
             if isinstance(ep, int) and peer_nodes and (
@@ -252,11 +271,13 @@ class Cluster:
 
     def _import_pool(self):
         if self._import_exec is None:
-            from concurrent.futures import ThreadPoolExecutor
+            with self._import_exec_lock:
+                if self._import_exec is None:
+                    from concurrent.futures import ThreadPoolExecutor
 
-            self._import_exec = ThreadPoolExecutor(
-                max_workers=16, thread_name_prefix="import-fanout"
-            )
+                    self._import_exec = ThreadPoolExecutor(
+                        max_workers=16, thread_name_prefix="import-fanout"
+                    )
         return self._import_exec
 
     def _peers(self, alive_only: bool = True) -> list[Node]:
@@ -303,8 +324,24 @@ class Cluster:
                 # add/remove applied on disjoint subsets): epochs alone
                 # can't order the lists, so the coordinator's view is
                 # authoritative — everyone converges to it (reference:
-                # the coordinator owns ResizeJob decisions)
-                best = (ep, peer_nodes)
+                # the coordinator owns ResizeJob decisions). EXCEPT when
+                # the coordinator's list lacks US: per-node epochs aren't
+                # comparable, so an equal epoch cannot prove a removal —
+                # a joined node whose forward to the coordinator was lost
+                # would brick itself. Re-announce instead; the add bumps
+                # the coordinator's epoch and everyone converges forward.
+                if not any(d["uri"] == self.me.uri for d in peer_nodes):
+                    try:
+                        self.client._json(
+                            "POST",
+                            n.uri,
+                            "/internal/cluster/join",
+                            {"id": self.me.id, "uri": self.me.uri},
+                        )
+                    except PeerError:
+                        pass
+                else:
+                    best = (ep, peer_nodes)
         if best is not None:
             self._adopt_topology(*best)
         if self.state in (STATE_NORMAL, STATE_DEGRADED):
@@ -329,7 +366,18 @@ class Cluster:
                 continue
             known = by_uri.get(d["uri"])
             if known is not None:
-                known.id = d["id"]
+                if known.id != d["id"]:
+                    # re-key cached inventories: ids are config-dependent
+                    # and adoption aligns ours to the adopted list —
+                    # leaving entries under the old id would blind
+                    # holder-preferring routing until the next heartbeat
+                    for (nid, idx_name) in [
+                        k for k in self._peer_shards if k[0] == known.id
+                    ]:
+                        self._peer_shards[(d["id"], idx_name)] = (
+                            self._peer_shards.pop((nid, idx_name))
+                        )
+                    known.id = d["id"]
                 known.is_coordinator = bool(d.get("isCoordinator"))
                 new_nodes.append(known)
             else:
@@ -400,16 +448,6 @@ class Cluster:
         except PeerError:
             node.alive = False
         return node.alive
-
-    def _node_has_shard(self, node: Node, index: str, shard: int) -> bool:
-        """Best-effort 'does this node hold the fragment': local holder
-        truth for self; the last-reported inventory (global_shards cache)
-        for peers. Unknown peers report False — routing then falls back
-        to the plain owner order, i.e. exactly the old behavior."""
-        if node.id == self.me.id:
-            idx = self.server.holder.index(index)
-            return idx is not None and shard in idx.available_shards()
-        return shard in self._peer_shards.get((node.id, index), ())
 
     def _alive_for_read(self, node: Node) -> bool:
         """Heartbeat-state liveness for READ routing — no synchronous
@@ -717,6 +755,19 @@ class Cluster:
             all_shards = [0]
         by_node: dict[str, list[int]] = {}
         node_by_id = {n.id: n for n in self.nodes}
+        # per-node holdings resolved ONCE per read, not per shard (the
+        # local available_shards set is a union over all fragments)
+        idx_obj = self.server.holder.index(index)
+        local_avail = idx_obj.available_shards() if idx_obj else set()
+        holdings = {
+            n.id: (
+                local_avail
+                if n.id == self.me.id
+                else self._peer_shards.get((n.id, index), ())
+            )
+            for n in self.nodes
+        }
+        read_alive = [n for n in self.nodes if self._alive_for_read(n)]
         for s in all_shards:
             alive_owners = [
                 n for n in self.shard_nodes(index, s) if self._alive_for_read(n)
@@ -731,17 +782,11 @@ class Cluster:
             # data through the window (reference: ResizeJob serves from
             # the old assignment until the job completes).
             primary = next(
-                (n for n in alive_owners if self._node_has_shard(n, index, s)),
-                None,
+                (n for n in alive_owners if s in holdings[n.id]), None
             )
             if primary is None:
                 primary = next(
-                    (
-                        n
-                        for n in self.nodes
-                        if self._alive_for_read(n)
-                        and self._node_has_shard(n, index, s)
-                    ),
+                    (n for n in read_alive if s in holdings[n.id]),
                     alive_owners[0],
                 )
             by_node.setdefault(primary.id, []).append(s)
@@ -1296,10 +1341,12 @@ class Cluster:
             delivered[sh] += 1
             took_write.setdefault(sh, []).append(self.me.uri)
         for sh, fut in futs:
-            fut.result()
+            # the receiver reports who actually APPLIED the slice — it
+            # may have re-forwarded to the current owners if our
+            # topology was stale, and the announce below must name the
+            # real holders
+            took_write.setdefault(sh, []).extend(fut.result())
             delivered[sh] += 1
-        for sh, o, _sub in remote:
-            took_write.setdefault(sh, []).append(o.uri)
         for sh, d in delivered.items():
             if d == 0:
                 raise ShardUnavailableError(
@@ -1715,27 +1762,29 @@ class Cluster:
         handler._json({"fragments": frags})
 
     def _h_import_bits(self, handler, index: str, field: str) -> None:
-        self._apply_or_reforward_import(
+        applied_by = self._apply_or_reforward_import(
             index, field, handler._json_body(), values=False
         )
-        handler._json({"success": True})
+        handler._json({"success": True, "appliedBy": applied_by})
 
     def _h_import_values(self, handler, index: str, field: str) -> None:
-        self._apply_or_reforward_import(
+        applied_by = self._apply_or_reforward_import(
             index, field, handler._json_body(), values=True
         )
-        handler._json({"success": True})
+        handler._json({"success": True, "appliedBy": applied_by})
 
     def _apply_or_reforward_import(
         self, index: str, field: str, payload: dict, values: bool
-    ) -> None:
+    ) -> list[str]:
         """Authoritative-receiver import: a node whose topology is stale
         (e.g. mid-join) fans out to OLD owners; if this node no longer
         owns the payload's shard, re-forward to the current owners so the
         bits land where reads route — otherwise they'd sit invisible in a
         relinquished fragment until the anti-entropy handoff. The
         `reforwarded` flag stops ping-pong when two nodes disagree about
-        ownership: the second hop applies locally and lets AE reconcile."""
+        ownership: the second hop applies locally and lets AE reconcile.
+        Returns the URIs that actually APPLIED the payload, so the
+        router's shard announce names real holders, not this node."""
         cols = payload.get("columnIDs", [])
         shard = int(cols[0]) // SHARD_WIDTH if cols else 0
         if (
@@ -1745,23 +1794,27 @@ class Cluster:
         ):
             fwd = dict(payload)
             fwd["reforwarded"] = True
-            delivered = 0
+            applied_by: list[str] = []
             for owner in self.shard_nodes(index, shard):
                 if not self._probe_alive(owner):
                     continue
                 try:
-                    self.client.import_node(owner.uri, index, field, fwd, values)
-                    delivered += 1
+                    applied_by.extend(
+                        self.client.import_node(
+                            owner.uri, index, field, fwd, values
+                        )
+                    )
                 except PeerError:
                     continue
-            if delivered:
-                return
+            if applied_by:
+                return applied_by
             # every current owner unreachable: apply locally — the bits
             # survive here and hand off at the next anti-entropy pass
         if values:
             self.server.api.import_values(index, field, payload)
         else:
             self.server.api.import_bits(index, field, payload)
+        return [self.me.uri]
 
     def _attr_store_from_params(self, handler):
         """Resolve the attr store named by index= [+ field=] params:
