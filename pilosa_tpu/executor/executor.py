@@ -21,6 +21,7 @@ Redesigned for TPU:
 
 from __future__ import annotations
 
+import os
 from datetime import datetime
 from typing import Any
 
@@ -39,7 +40,12 @@ from pilosa_tpu.core import (
     Holder,
     Index,
 )
-from pilosa_tpu.executor.compile import PlanError, QueryCompiler, StackOverBudget
+from pilosa_tpu.executor.compile import (
+    PlanError,
+    QueryCompiler,
+    StackOverBudget,
+    _stack_budget,
+)
 from pilosa_tpu.executor.row import RowResult
 from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
@@ -188,13 +194,9 @@ class Executor:
         construction (backend init)."""
         if self.GROUPBY_MASK_BUDGET is not None:
             return self.GROUPBY_MASK_BUDGET
-        import os
-
         env = os.environ.get("PILOSA_TPU_GROUPBY_BUDGET")
         if env:
             return int(env)
-        from pilosa_tpu.executor.compile import _stack_budget
-
         return max(256 << 20, _stack_budget() // 8)
 
     def __init__(self, holder: Holder, mesh_ctx=None):

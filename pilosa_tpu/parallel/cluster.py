@@ -923,6 +923,10 @@ class Cluster:
         disjoint shards, so that is exact by construction, the reference's
         cache-miss behavior being approximate instead)."""
         n = int(call.arg("n"))
+
+        def topn_call(args: dict) -> Call:
+            return Call("TopN", args, list(call.children), list(call.pos_args))
+
         # iterative deepening: on a skewed (Zipfian) distribution the
         # cutoff drops fast with n', so widening usually proves exactness
         # in one or two rounds; only a genuinely flat distribution — where
@@ -935,10 +939,7 @@ class Cluster:
         for _ in range(5):
             headroom = {**call.args, "n": headroom_n}
             phase1 = self._fanout(
-                index,
-                Call("TopN", headroom, list(call.children), list(call.pos_args)),
-                by_node,
-                node_by_id,
+                index, topn_call(headroom), by_node, node_by_id
             )
             bound = sum(
                 p[-1]["count"] if len(p) >= headroom_n else 0
@@ -954,10 +955,7 @@ class Cluster:
             args = {k: v for k, v in call.args.items() if k != "n"}
             args["ids"] = cand
             phase2 = self._fanout(
-                index,
-                Call("TopN", args, list(call.children), list(call.pos_args)),
-                by_node,
-                node_by_id,
+                index, topn_call(args), by_node, node_by_id
             )
             merged: dict[int, int] = {}
             for p in phase2:
@@ -971,12 +969,7 @@ class Cluster:
         # exhaustive pass (n stripped — every nonzero row comes back)
         # settles membership exactly
         args = {k: v for k, v in call.args.items() if k != "n"}
-        return self._fanout(
-            index,
-            Call("TopN", args, list(call.children), list(call.pos_args)),
-            by_node,
-            node_by_id,
-        )
+        return self._fanout(index, topn_call(args), by_node, node_by_id)
 
     def wait_rebalanced(self, timeout: float | None = None) -> None:
         """Block until the background join-rebalance pull (if any) has
@@ -1281,19 +1274,20 @@ class Cluster:
             ]
         cols = np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
         shards = cols // np.uint64(SHARD_WIDTH)
+        uniq_shards = [int(s) for s in np.unique(shards).tolist()]
         # shards become "known" (and get announced) only AFTER successful
         # delivery — marking them early would make a failed attempt
         # permanently suppress the announce on the client's retry
         new_shards = [
-            int(s)
-            for s in np.unique(shards).tolist()
-            if int(s) not in self._known_shards.get(index, set())
+            s
+            for s in uniq_shards
+            if s not in self._known_shards.get(index, set())
         ]
         local: list[tuple[int, dict]] = []
         remote: list[tuple[int, Node, dict]] = []
         delivered: dict[int, int] = {}
         took_write: dict[int, list[str]] = {}  # shard → owner URIs that got it
-        for shard in np.unique(shards).tolist():
+        for shard in uniq_shards:
             m = shards == shard
             sub = dict(payload)
             sub["columnIDs"] = cols[m].tolist()
@@ -1352,9 +1346,9 @@ class Cluster:
                 raise ShardUnavailableError(
                     f"no alive owner for shard {sh}; import rejected"
                 )
-        self._known_shards[index] = self._known_shards.get(index, set()) | {
-            int(s) for s in np.unique(shards).tolist()
-        }
+        self._known_shards[index] = (
+            self._known_shards.get(index, set()) | set(uniq_shards)
+        )
         if new_shards:
             # synchronous announce BEFORE acking the import: a client may
             # import through this node and immediately read through any
