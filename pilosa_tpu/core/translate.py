@@ -24,6 +24,10 @@ class TranslateStore:
         self._by_key: dict[str, int] = {}
         self._by_id: dict[int, str] = {}
         self._next_id = 1  # 0 is reserved (reference never allocates 0)
+        # highest id N such that ids 1..N are ALL present. Replica tailing
+        # must resume from this watermark, not max(_by_id): a hole below
+        # max (a missed primary push) would otherwise never be refilled.
+        self._dense_through = 0
         self._file = None
 
     def open(self) -> None:
@@ -41,7 +45,10 @@ class TranslateStore:
                             entry = json.loads(line)
                         except json.JSONDecodeError:
                             break  # torn tail write
-                        self._apply(entry["k"], entry["id"])
+                        # replay with displacement: the log may record a
+                        # fork reconciliation (winning entry appended
+                        # after the stale one) — last write wins cleanly
+                        self._apply_displacing(entry["k"], entry["id"], [])
             self._file = open(self.path, "a")
 
     def close(self) -> None:
@@ -54,6 +61,14 @@ class TranslateStore:
         self._by_key[key] = id_
         self._by_id[id_] = key
         self._next_id = max(self._next_id, id_ + 1)
+        while self._dense_through + 1 in self._by_id:
+            self._dense_through += 1
+
+    @property
+    def dense_through(self) -> int:
+        """Replica tailing cursor: every id ≤ this is present locally."""
+        with self._lock:
+            return self._dense_through
 
     def translate_key(self, key: str, create: bool = True) -> int | None:
         """key → ID, allocating when ``create`` (reference:
@@ -91,11 +106,45 @@ class TranslateStore:
             tail = [(k, i) for i, k in items if i > offset]
             return [(k, i) for (k, i) in tail], (items[-1][0] if items else 0)
 
-    def apply_entries(self, entries: list[tuple[str, int]]) -> None:
+    def apply_entries(
+        self, entries: list[tuple[str, int]]
+    ) -> list[tuple[str, int]]:
+        """Apply replicated entries; the incoming (primary-chain) binding
+        WINS conflicts. Returns the local bindings that were displaced —
+        non-empty only after a keyspace fork (a deposed primary's
+        never-replicated allocations colliding with the surviving chain),
+        so callers log them. Reference: translate.go replicas tail the
+        primary verbatim and can't conflict; this store can, because it
+        supports primary failover (see cluster._ensure_translate_primacy).
+        """
+        dropped: list[tuple[str, int]] = []
         with self._lock:
             for key, id_ in entries:
-                self._apply(key, id_)
-                if self._file:
+                if self._apply_displacing(key, id_, dropped) and self._file:
                     self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
             if self._file:
                 self._file.flush()
+        return dropped
+
+    def _apply_displacing(
+        self, key: str, id_: int, dropped: list[tuple[str, int]]
+    ) -> bool:
+        """_apply plus removal of any binding the new entry displaces
+        (appended to ``dropped``). Returns False when the entry was
+        already present (callers skip the log write, keeping it O(delta)).
+        """
+        old_key = self._by_id.get(id_)
+        if old_key == key:
+            return False
+        if old_key is not None:
+            dropped.append((old_key, id_))
+            del self._by_key[old_key]
+        old_id = self._by_key.get(key)
+        if old_id is not None and old_id != id_:
+            dropped.append((key, old_id))
+            if self._by_id.get(old_id) == key:
+                del self._by_id[old_id]
+                # the removal punches a hole: tailing must re-cover it
+                self._dense_through = min(self._dense_through, old_id - 1)
+        self._apply(key, id_)
+        return True

@@ -83,11 +83,33 @@ class Cluster:
         # going down surfaces as ShardUnavailableError at routing instead
         # of a silently partial result
         self._peer_shards: dict[tuple[str, str], set[int]] = {}
+        # guards MERGE-and-assign updates of the two shard caches (two
+        # concurrent announces/imports would lose one side's update in a
+        # get|set race, transiently breaking read-your-writes). Readers
+        # stay lock-free: whole-set assignment is atomic.
+        self._shard_cache_lock = threading.Lock()
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
         self._import_exec_lock = threading.Lock()
         self._closed = False
+        # translate-primary failover fencing (reference: translate.go has a
+        # FIXED primary; this cluster fails allocation over to the
+        # sorted-first alive node, which must first prove its counter is
+        # ahead of every allocation the deposed primary replicated):
+        #   _translate_fence_ok    — this node may allocate without fencing
+        #   _translate_reconcile_pending — full-pull our stores from the
+        #       current primary before trusting local caches (set at boot:
+        #       a restarted ex-primary can hold never-replicated ids)
+        #   _observed_primary_id   — primacy-transition edge detector
+        self._translate_fence_ok = False
+        self._translate_reconcile_pending = True
+        self._observed_primary_id: str | None = None
+        self._translate_fence_lock = threading.Lock()
+        # bumped (under the lock) on every observed primacy transition; a
+        # fence that straddles a transition must not stamp itself valid
+        self._primacy_gen = 0
+        self._reconcile_thread: threading.Thread | None = None
 
     # ------------------------------------------------------------ membership
     @property
@@ -187,6 +209,15 @@ class Cluster:
         for _n, st in statuses:
             ep = st.get("topologyEpoch")
             peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
+            if not any(d.get("uri") == self.me.uri for d in peer_nodes):
+                # a list lacking us is NOT adoptable while we are booting:
+                # either our join POST to this peer failed transiently
+                # (adopting would self-remove — one dropped RPC bricking
+                # the boot) or it raced the announce. Skip it; a GENUINE
+                # removal still converges via the heartbeat path, which
+                # requires a strictly-higher-epoch list from a cluster
+                # that already knew us.
+                continue
             if isinstance(ep, int) and peer_nodes and (
                 best is None or ep > best[0]
             ):
@@ -346,6 +377,29 @@ class Cluster:
             self._adopt_topology(*best)
         if self.state in (STATE_NORMAL, STATE_DEGRADED):
             self.state = STATE_DEGRADED if degraded else STATE_NORMAL
+        self._track_translate_primacy()
+
+    def _track_translate_primacy(self) -> None:
+        """Edge-detect translate-primacy transitions from the freshly
+        updated liveness flags. Losing primacy invalidates the fence (a
+        later RE-promotion must re-fence: the interim primary may have
+        allocated); a demoted ex-primary arms a full reconcile so any
+        never-replicated local allocation is displaced by the surviving
+        chain instead of poisoning later fences."""
+        try:
+            primary = self._translate_primary()
+        except ShardUnavailableError:
+            return
+        with self._translate_fence_lock:
+            if primary.id != self._observed_primary_id:
+                self._primacy_gen += 1
+                if primary.id != self.me.id:
+                    self._translate_fence_ok = False
+                    if self._observed_primary_id == self.me.id:
+                        self._translate_reconcile_pending = True
+                self._observed_primary_id = primary.id
+        if self._translate_reconcile_pending and self.server.holder is not None:
+            self._maybe_reconcile_translations(primary)
 
     def _adopt_topology(self, epoch: int, node_dicts: list[dict]) -> None:
         """Adopt a peer's higher-epoch membership list. Keeps this node's
@@ -636,8 +690,9 @@ class Cluster:
         shards: set[int] = set(idx.available_shards()) if idx else set()
         for n in self._peers(alive_only=False):
             shards |= self._peer_shards.get((n.id, index), set())
-        merged = self._known_shards.get(index, set()) | shards
-        self._known_shards[index] = merged  # assignment: lock-free readers
+        with self._shard_cache_lock:
+            merged = self._known_shards.get(index, set()) | shards
+            self._known_shards[index] = merged  # assignment: lock-free readers
         return sorted(merged)
 
     def _purge_shard_caches(self, index: str) -> None:
@@ -694,18 +749,21 @@ class Cluster:
         # on the HTTP handler thread while concurrent reads iterate the
         # same sets lock-free — set replacement is atomic, mutation isn't
         index = payload["index"]
-        for uri, sh in payload.get("entries", {}).items():
-            node = next((x for x in self.nodes if x.uri == uri), None)
-            if node is None or node.id == self.me.id:
-                continue  # local truth comes from the holder
-            key = (node.id, index)
-            if payload.get("replace"):
-                self._peer_shards[key] = set(sh)
-            else:
-                self._peer_shards[key] = self._peer_shards.get(key, set()) | set(sh)
-        self._known_shards[index] = self._known_shards.get(index, set()) | {
-            s for sh in payload.get("entries", {}).values() for s in sh
-        }
+        with self._shard_cache_lock:
+            for uri, sh in payload.get("entries", {}).items():
+                node = next((x for x in self.nodes if x.uri == uri), None)
+                if node is None or node.id == self.me.id:
+                    continue  # local truth comes from the holder
+                key = (node.id, index)
+                if payload.get("replace"):
+                    self._peer_shards[key] = set(sh)
+                else:
+                    self._peer_shards[key] = (
+                        self._peer_shards.get(key, set()) | set(sh)
+                    )
+            self._known_shards[index] = self._known_shards.get(index, set()) | {
+                s for sh in payload.get("entries", {}).values() for s in sh
+            }
 
     # -------------------------------------------------------------- queries
     def query(self, index: str, pql: str, shards: list[int] | None) -> dict:
@@ -1161,9 +1219,10 @@ class Cluster:
             # known/announced only after the write landed (a failed
             # attempt must not suppress the announce on retry), and only
             # naming owners that actually took it
-            self._known_shards[index] = self._known_shards.get(index, set()) | {
-                shard
-            }
+            with self._shard_cache_lock:
+                self._known_shards[index] = (
+                    self._known_shards.get(index, set()) | {shard}
+                )
             if is_new:
                 self._announce_shards(
                     index, {uri: [shard] for uri in took_write}
@@ -1264,14 +1323,14 @@ class Cluster:
         # cluster-consistent key translation through the primary
         if payload.get("columnKeys"):
             payload = dict(payload)
-            payload["columnIDs"] = [
-                self.translate_column_key(index, k) for k in payload.pop("columnKeys")
-            ]
+            payload["columnIDs"] = self.translate_column_keys(
+                index, payload.pop("columnKeys")
+            )
         if payload.get("rowKeys"):
             payload = dict(payload)
-            payload["rowIDs"] = [
-                self.translate_row_key(index, field, k) for k in payload.pop("rowKeys")
-            ]
+            payload["rowIDs"] = self.translate_row_keys(
+                index, field, payload.pop("rowKeys")
+            )
         cols = np.asarray(payload.get("columnIDs", []), dtype=np.uint64)
         shards = cols // np.uint64(SHARD_WIDTH)
         uniq_shards = [int(s) for s in np.unique(shards).tolist()]
@@ -1346,9 +1405,10 @@ class Cluster:
                 raise ShardUnavailableError(
                     f"no alive owner for shard {sh}; import rejected"
                 )
-        self._known_shards[index] = (
-            self._known_shards.get(index, set()) | set(uniq_shards)
-        )
+        with self._shard_cache_lock:
+            self._known_shards[index] = (
+                self._known_shards.get(index, set()) | set(uniq_shards)
+            )
         if new_shards:
             # synchronous announce BEFORE acking the import: a client may
             # import through this node and immediately read through any
@@ -1380,7 +1440,9 @@ class Cluster:
         store = api._translate_store(index, field)  # validates keys option
         primary = self._translate_primary()
         if primary.id == self.me.id:
-            return api.translate_keys(index, field, keys, create=create)
+            if create:
+                api.check_write_limit(len(keys), "translate")
+            return self._primary_allocate(index, field, store, keys, create)
         if create:
             api.check_write_limit(len(keys), "translate")
         # local-cache-first (same discipline as _col_key_lookup): entries
@@ -1416,31 +1478,247 @@ class Cluster:
                 return n
         raise ShardUnavailableError("no alive nodes for key translation")
 
-    def translate_column_key(self, index: str, key: str) -> int:
+    def _primary_allocate(
+        self, index: str, field: str | None, store, keys: list[str], create: bool
+    ) -> list[int | None]:
+        """Every key→id ALLOCATION on this node funnels through here.
+        Two duties beyond the raw store call (reference: translate.go has
+        a fixed primary so needs neither; failover makes both mandatory):
+
+        1. Fence-on-promotion: before the FIRST allocation of a primacy
+           term, catch the local counter up past every allocation the
+           deposed primary managed to replicate (else a stale _next_id
+           re-issues live ids for new keys — a silent keyspace fork).
+        2. Replicate-before-ack: push freshly created entries to every
+           alive peer synchronously, so a subsequent failover to ANY of
+           them finds the allocation and the fence in (1) can see it.
+        """
+        if not create:
+            return store.translate_keys(keys, create=False)
+        self._ensure_translate_primacy()
+        pre = store.translate_keys(keys, create=False)
+        miss = {k for k, i in zip(keys, pre) if i is None}
+        ids = store.translate_keys(keys, create=True)
+        if miss:
+            new = {}
+            for k, i in zip(keys, ids):
+                if k in miss and i is not None:
+                    new[k] = i
+            self._push_translate_entries(index, field, sorted(new.items()))
+        return ids
+
+    def _push_translate_entries(
+        self, index: str, field: str | None, entries: list[tuple[str, int]]
+    ) -> None:
+        """Synchronous fan-out of new allocations to alive peers, BEFORE
+        the client ack. The fence's safety argument REQUIRES that every
+        currently-alive peer — the only failover candidates — holds the
+        entry when the ack goes out, so a push failure to a peer that is
+        still alive (probe confirms) REFUSES the allocation ack; the
+        client retries and the already-bound keys re-push idempotently.
+        A peer the probe confirms dead is tolerated: it re-learns by
+        reconcile-tailing on rejoin. Residual window (documented, not
+        closable without quorum consensus): primary + every pushed peer
+        die together after an ack — rejoin reconcile then resolves any
+        resulting fork toward the surviving chain, displacing one side.
+        """
+        if not entries:
+            return
+        payload: dict = {"index": index, "entries": [[k, i] for k, i in entries]}
+        if field:
+            payload["field"] = field
+
+        def push(peer: Node) -> str | None:
+            try:
+                self.client._json(
+                    "POST", peer.uri, "/internal/translate/apply", payload
+                )
+                return None
+            except PeerError as e:
+                # a REAL probe, not the cached flag: only a peer that is
+                # verifiably down may miss the push without failing the
+                # ack (it reconcile-tails on rejoin)
+                try:
+                    self.client.status(peer.uri, timeout=5.0)
+                except PeerError:
+                    peer.alive = False
+                    self.server.logger.log(
+                        f"translate push skipped dead peer {peer.uri} "
+                        f"({e}); it will reconcile-tail on rejoin"
+                    )
+                    return None
+                return f"{peer.uri}: {e}"
+
+        peers = self._peers()
+        if len(peers) == 1:
+            failures = [f for f in [push(peers[0])] if f]
+        elif peers:
+            # concurrent fan-out: the ack waits on the SLOWEST peer, not
+            # the sum of peers
+            failures = [f for f in self._import_pool().map(push, peers) if f]
+        else:
+            failures = []
+        if failures:
+            raise ShardUnavailableError(
+                "translate replication incomplete (alive peer unreachable: "
+                f"{'; '.join(failures)}); allocation not acked — retry"
+            )
+
+    def _ensure_translate_primacy(self) -> None:
+        """Run the promotion fence before this term's first allocation.
+        Raises ShardUnavailableError — REFUSING the allocation — when the
+        fence could not pull from every alive peer: allocating behind an
+        incomplete fence is exactly the stale-counter fork it prevents.
+        The refusal is transient: the unreachable peer is either marked
+        dead by the next heartbeat (and leaves the fence set) or becomes
+        pullable. The pull itself runs outside the lock; a primacy
+        transition observed mid-fence (generation bump) invalidates the
+        attempt rather than stamping a fence that straddled two terms."""
+        for _ in range(3):
+            with self._translate_fence_lock:
+                if self._translate_fence_ok:
+                    return
+                gen0 = self._primacy_gen
+            # pull order decides conflict winners (apply_entries is
+            # incoming-wins): peers whose own chain is UNVERIFIED — a
+            # rejoined ex-primary still awaiting reconcile — are pulled
+            # FIRST, verified peers last, so a forked binding a pending
+            # peer still carries is displaced by the verified chain
+            # instead of peer iteration order silently deciding
+            peers: list[tuple[bool, Node]] = []
+            ok = True
+            for peer in self._peers():
+                try:
+                    st = self.client.status(peer.uri, timeout=5.0)
+                except PeerError:
+                    ok = False
+                    continue
+                peers.append((bool(st.get("translatePending")), peer))
+            peers.sort(key=lambda p: not p[0])  # pending=True first
+            ok = ok and all(
+                self._pull_translations_from(peer, full=True)
+                for _pending, peer in peers
+            )
+            if not ok:
+                raise ShardUnavailableError(
+                    "translate fence incomplete (an alive peer was "
+                    "unpullable); allocation refused — retry"
+                )
+            with self._translate_fence_lock:
+                if self._primacy_gen == gen0:
+                    self._translate_fence_ok = True
+                    self._observed_primary_id = self.me.id
+                    return
+        raise ShardUnavailableError(
+            "translate primacy flapping; allocation refused — retry"
+        )
+
+    def _pull_translations_from(self, node: Node, full: bool) -> bool:
+        """Pull key translations for every keyed store from ``node``.
+        ``full`` pulls from offset 0 (fencing/reconcile); otherwise from
+        the store's dense watermark — NOT max id, so a hole left by a
+        missed push is re-covered. Returns True when every store pulled
+        without a peer error."""
+        ok = True
+        for idx_name, idx in list(self.server.holder.indexes.items()):
+            stores: list[tuple[str | None, Any]] = []
+            if idx.options.keys:
+                stores.append((None, idx.column_keys))
+            for f_name, f in list(idx.fields.items()):
+                if f.options.keys:
+                    stores.append((f_name, f.row_keys))
+            for f_name, store in stores:
+                try:
+                    entries = self.client.translate_entries(
+                        node.uri, idx_name, f_name,
+                        0 if full else store.dense_through,
+                    )
+                except PeerError:
+                    ok = False
+                    continue
+                dropped = store.apply_entries(entries)
+                if dropped:
+                    self.server.logger.log(
+                        f"translate {idx_name}/{f_name or '<columns>'}: "
+                        f"dropped {len(dropped)} forked binding(s) "
+                        f"displaced by {node.uri}'s chain: "
+                        f"{dropped[:5]}{'…' if len(dropped) > 5 else ''}"
+                    )
+        return ok
+
+    def _maybe_reconcile_translations(self, primary: Node) -> None:
+        """Off-heartbeat-thread full reconcile against the current
+        primary. Armed at boot (a restarted ex-primary may hold
+        never-replicated allocations that conflict with the surviving
+        chain) and on demotion; cleared only after a clean full pull."""
+        t = self._reconcile_thread
+        if t is not None and t.is_alive():
+            return
+        with self._translate_fence_lock:
+            gen0 = self._primacy_gen
+
+        def clear_pending_if_current() -> None:
+            # a primacy transition mid-pull re-arms pending for the NEW
+            # term; a stale thread must not wipe that re-arm
+            with self._translate_fence_lock:
+                if self._primacy_gen == gen0:
+                    self._translate_reconcile_pending = False
+
+        def run() -> None:
+            if primary.id == self.me.id:
+                # we rejoined straight back into primacy (still sorted
+                # first): the fence IS the reconcile — it full-pulls from
+                # every alive peer, displacing any forked local binding
+                try:
+                    self._ensure_translate_primacy()
+                except ShardUnavailableError:
+                    return  # pending stays set; retried next heartbeat
+                clear_pending_if_current()
+            elif self._pull_translations_from(primary, full=True):
+                clear_pending_if_current()
+
+        t = threading.Thread(
+            target=run, daemon=True, name="translate-reconcile"
+        )
+        self._reconcile_thread = t
+        t.start()
+
+    def translate_column_keys(self, index: str, keys: list[str]) -> list[int]:
+        """Batch column-key allocation: ONE hop to the primary (or one
+        local allocate + one pooled push wave) regardless of batch size —
+        a keyed import must never pay per-key RPCs."""
         primary = self._translate_primary()
         if primary.id == self.me.id:
             idx = self.server.holder.index(index)
-            return idx.column_keys.translate_key(key, create=True)
+            return self._primary_allocate(index, None, idx.column_keys, keys, True)
         resp = self.client._json(
             "POST",
             primary.uri,
             "/internal/translate/create",
-            {"index": index, "keys": [key]},
+            {"index": index, "keys": keys},
         )
-        return resp["ids"][0]
+        return resp["ids"]
 
-    def translate_row_key(self, index: str, field: str, key: str) -> int:
+    def translate_row_keys(
+        self, index: str, field: str, keys: list[str]
+    ) -> list[int]:
         primary = self._translate_primary()
         if primary.id == self.me.id:
             f = self.server.holder.index(index).field(field)
-            return f.row_keys.translate_key(key, create=True)
+            return self._primary_allocate(index, field, f.row_keys, keys, True)
         resp = self.client._json(
             "POST",
             primary.uri,
             "/internal/translate/create",
-            {"index": index, "field": field, "keys": [key]},
+            {"index": index, "field": field, "keys": keys},
         )
-        return resp["ids"][0]
+        return resp["ids"]
+
+    def translate_column_key(self, index: str, key: str) -> int:
+        return self.translate_column_keys(index, [key])[0]
+
+    def translate_row_key(self, index: str, field: str, key: str) -> int:
+        return self.translate_row_keys(index, field, [key])[0]
 
     # --------------------------------------------------------- anti-entropy
     def sync_holder(self) -> None:
@@ -1507,12 +1785,19 @@ class Cluster:
                 )
             except PeerError:
                 return False
-        if frag.version != v0:
-            # a write raced in after the serialize — its bits aren't in
-            # what we pushed, so keep the copy; the next anti-entropy
-            # pass re-pushes and retires it
-            return False
-        return view.remove_fragment(shard)
+        # the re-check and the removal must be ONE atomic step under the
+        # fragment write lock: a write (e.g. a re-forwarded import, which
+        # applies locally on the old owner by design) landing between
+        # them would be deleted with the fragment — silent loss. Every
+        # mutation path takes frag._lock, so holding it here closes the
+        # window; RLock keeps remove_fragment→frag.close() reentrant.
+        with frag._lock:
+            if frag.version != v0:
+                # a write raced in after the serialize — its bits aren't
+                # in what we pushed, so keep the copy; the next
+                # anti-entropy pass re-pushes and retires it
+                return False
+            return view.remove_fragment(shard)
 
     def _sync_attr_stores(self, idx_name: str, idx) -> None:
         """Block-checksum diff of the column/row attr stores against all
@@ -1569,26 +1854,12 @@ class Cluster:
         primary = self._translate_primary()
         if primary.id == self.me.id:
             return
-        for idx_name, idx in self.server.holder.indexes.items():
-            if idx.options.keys:
-                try:
-                    offset = max(idx.column_keys._by_id, default=0)
-                    entries = self.client.translate_entries(
-                        primary.uri, idx_name, None, offset
-                    )
-                    idx.column_keys.apply_entries(entries)
-                except PeerError:
-                    pass
-            for f_name, f in idx.fields.items():
-                if f.options.keys:
-                    try:
-                        offset = max(f.row_keys._by_id, default=0)
-                        entries = self.client.translate_entries(
-                            primary.uri, idx_name, f_name, offset
-                        )
-                        f.row_keys.apply_entries(entries)
-                    except PeerError:
-                        pass
+        # a pending reconcile (armed at boot / on demotion) upgrades the
+        # incremental tail to a full pull — AE runs off the heartbeat
+        # thread, so doing it inline here is fine
+        full = self._translate_reconcile_pending
+        if self._pull_translations_from(primary, full=full) and full:
+            self._translate_reconcile_pending = False
 
     # ------------------------------------------------------ internal routes
     def _mount_internal_routes(self) -> None:
@@ -1621,6 +1892,10 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/translate/create$"),
             ): self._h_translate_create,
+            (
+                "POST",
+                re.compile(r"^/internal/translate/apply$"),
+            ): self._h_translate_apply,
             ("POST", re.compile(r"^/internal/sync$")): self._h_sync,
             (
                 "POST",
@@ -1780,7 +2055,18 @@ class Cluster:
         Returns the URIs that actually APPLIED the payload, so the
         router's shard announce names real holders, not this node."""
         cols = payload.get("columnIDs", [])
-        shard = int(cols[0]) // SHARD_WIDTH if cols else 0
+        span = {int(c) // SHARD_WIDTH for c in cols}
+        if len(span) > 1:
+            # the node↔node import contract is single-shard (the router
+            # splits before fan-out). Forwarding/applying a multi-shard
+            # payload wholesale under ONE shard's ownership decision
+            # would park other shards' bits on a non-owner, invisible to
+            # reads until anti-entropy — enforce, don't assume.
+            raise ValueError(
+                f"internal import spans shards {sorted(span)}; "
+                "single-shard payloads required"
+            )
+        shard = span.pop() if span else 0
         if (
             not payload.get("reforwarded")
             and cols
@@ -1870,11 +2156,67 @@ class Cluster:
         store = (
             idx.field(body["field"]).row_keys if body.get("field") else idx.column_keys
         )
-        ids = store.translate_keys(body["keys"], create=body.get("create", True))
+        create = body.get("create", True)
+        primary = self._translate_primary()
+        if create and primary.id != self.me.id:
+            # a sender with a stale liveness view posted its create here:
+            # allocating from this node's counter would fork the keyspace.
+            # Forward ONE hop to the primary we see; a forwarded request
+            # landing on another non-primary (liveness views still
+            # settling) refuses instead of looping.
+            if body.get("fwd"):
+                handler._json(
+                    {"error": "not translate primary"}, code=503
+                )
+                return
+            try:
+                resp = self.client._json(
+                    "POST",
+                    primary.uri,
+                    "/internal/translate/create",
+                    dict(body, fwd=True),
+                )
+            except PeerError as e:
+                handler._json(
+                    {"error": f"translate primary unavailable: {e}"}, code=503
+                )
+                return
+            ids = resp["ids"]
+            store.apply_entries(
+                [(k, i) for k, i in zip(body["keys"], ids) if i]
+            )
+        else:
+            ids = self._primary_allocate(
+                body["index"], body.get("field"), store, body["keys"], create
+            )
         if proto:
             handler._proto(encoding.protoser.translate_keys_response_to_bytes(ids))
         else:
             handler._json({"ids": ids})
+
+    def _h_translate_apply(self, handler) -> None:
+        """Receiver for the primary's replicate-before-ack entry push.
+        Unknown index/field (schema broadcast raced the push) is not an
+        error — the entries arrive again via tailing."""
+        body = handler._json_body()
+        idx = self.server.holder.index(body["index"])
+        store = None
+        if idx is not None:
+            if body.get("field"):
+                f = idx.field(body["field"])
+                store = f.row_keys if f is not None else None
+            else:
+                store = idx.column_keys
+        if store is None:
+            handler._json({"applied": False})
+            return
+        dropped = store.apply_entries([(k, i) for k, i in body["entries"]])
+        if dropped:
+            self.server.logger.log(
+                f"translate apply {body['index']}/{body.get('field') or '<columns>'}: "
+                f"primary push displaced {len(dropped)} local binding(s)"
+            )
+        handler._json({"applied": True})
 
 
 def serialize_empty() -> bytes:

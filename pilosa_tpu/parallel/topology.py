@@ -91,8 +91,11 @@ class Topology:
         memberlist join → ResizeJob placement diff)."""
         if any(n.uri == node.uri for n in self.nodes):
             return False
-        self.nodes.append(node)
-        self.nodes.sort(key=lambda n: n.id)
+        # build-then-rebind, never sort in place: list.sort detaches the
+        # buffer mid-sort, so a lock-free concurrent reader (read routing,
+        # heartbeats) could observe an empty/partial node list during a
+        # join (same discipline as remove/_adopt_topology)
+        self.nodes = sorted([*self.nodes, node], key=lambda n: n.id)
         self.epoch += 1
         return True
 

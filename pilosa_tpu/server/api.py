@@ -164,6 +164,14 @@ class API:
                     idx.create_field(
                         f_def["name"], field_options_from_json(f_def.get("options", {}))
                     )
+        if self.cluster is not None:
+            # a keyed store learned AFTER this node's promotion fence was
+            # stamped would allocate from an empty counter (the fence
+            # pulled nothing for a store it didn't know existed) — any
+            # schema application invalidates the fence; re-fencing on the
+            # next allocation is cheap
+            with self.cluster._translate_fence_lock:
+                self.cluster._translate_fence_ok = False
 
     # -------------------------------------------------------------- query
     def check_write_limit(self, n: int, what: str) -> None:
@@ -397,6 +405,13 @@ class API:
 
     def topology_epoch(self) -> int:
         return self.cluster.topology.epoch if self.cluster is not None else 0
+
+    def translate_pending(self) -> bool:
+        return (
+            self.cluster._translate_reconcile_pending
+            if self.cluster is not None
+            else False
+        )
 
     def node_inventories(self) -> dict:
         return {

@@ -348,6 +348,11 @@ class Handler(BaseHTTPRequestHandler):
                 "nodes": self.api.hosts(),
                 "localID": self.server.node_id,
                 "topologyEpoch": self.api.topology_epoch(),
+                # True while this node's translate stores are awaiting a
+                # full reconcile (boot / post-demotion): a fencing
+                # promoter pulls such unverified chains FIRST so verified
+                # peers' entries win any conflict
+                "translatePending": self.api.translate_pending(),
                 # full per-index shard inventory piggybacks on the
                 # heartbeat (reference: availableShards travels in
                 # gossip ClusterStatus) — peers route reads from this
