@@ -41,6 +41,7 @@ Scale knobs via env:
 
 from __future__ import annotations
 
+import glob
 import json
 import os
 import subprocess
@@ -406,8 +407,6 @@ def main() -> None:
             result["error"] = f"accelerator unavailable ({last_err}); cpu fallback"
             # point the reader at the newest manually-captured real-chip
             # artifact (bench runs saved when the tunnel was healthy)
-            import glob
-
             tpu_artifacts = sorted(
                 glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                        "BENCH_r*_tpu.json")))

@@ -516,6 +516,12 @@ class Executor:
         field = self._field(idx, self._call_field_name(call))
         n = call.arg("n")
         ids = call.arg("ids")
+        # internal (cluster fan-out) arg: return only rows whose LOCAL
+        # count reaches the floor — the coordinator's bounded final TopN
+        # pass (cluster._topn_two_phase) uses it so the worst-case
+        # cross-node transfer is O(rows above the proven cutoff), never
+        # every nonzero row
+        min_count = call.arg("minCount")
         attr_name = call.arg("attrName")
         attr_values = call.arg("attrValues")
         if attr_name is not None and not attr_values:
@@ -533,7 +539,9 @@ class Executor:
             pairs = self._topn_chunked(
                 idx, field, shards, filt, ids=ids
             )
-            return self._topn_finish(field, pairs, n, attr_name, attr_values)
+            return self._topn_finish(
+                field, pairs, n, attr_name, attr_values, min_count
+            )
         fplan = self._filter_plan(idx, call, shards)
         if ids is not None:
             row_ids = jnp.asarray(ids, jnp.int32)
@@ -574,7 +582,9 @@ class Executor:
                 pairs = [
                     (int(r), int(c)) for r, c in zip(ids, a[0].tolist()) if c > 0
                 ]
-                return self._topn_finish(field, pairs, n, attr_name, attr_values)
+                return self._topn_finish(
+                    field, pairs, n, attr_name, attr_values, min_count
+                )
 
         else:
             if fplan is not None:
@@ -610,15 +620,19 @@ class Executor:
             def finish(a):
                 nz = np.flatnonzero(a[0])
                 pairs = [(int(r), int(a[0][r])) for r in nz.tolist()]
-                return self._topn_finish(field, pairs, n, attr_name, attr_values)
+                return self._topn_finish(
+                    field, pairs, n, attr_name, attr_values, min_count
+                )
 
         pend = _Pending([counts], finish)
         return pend if lazy else pend.resolve_now()
 
     @staticmethod
     def _topn_finish(
-        field: Field, pairs: list, n, attr_name, attr_values
+        field: Field, pairs: list, n, attr_name, attr_values, min_count=None
     ) -> list[dict]:
+        if min_count is not None:
+            pairs = [(r, c) for r, c in pairs if c >= min_count]
         if attr_name is not None:
             allowed = set(attr_values)
             pairs = [

@@ -53,6 +53,11 @@ HEARTBEAT_INTERVAL = 2.0
 
 
 class Cluster:
+    # TopN iterative-deepening rounds before the bounded minCount sweep
+    # (up to 256× the initial headroom). Class attr so tests can force
+    # the sweep path deterministically.
+    TOPN_DEEPEN_ROUNDS = 5
+
     def __init__(self, server):
         self.server = server
         self.config = server.config
@@ -425,12 +430,13 @@ class Cluster:
                     # and adoption aligns ours to the adopted list —
                     # leaving entries under the old id would blind
                     # holder-preferring routing until the next heartbeat
-                    for (nid, idx_name) in [
-                        k for k in self._peer_shards if k[0] == known.id
-                    ]:
-                        self._peer_shards[(d["id"], idx_name)] = (
-                            self._peer_shards.pop((nid, idx_name))
-                        )
+                    with self._shard_cache_lock:
+                        for (nid, idx_name) in [
+                            k for k in self._peer_shards if k[0] == known.id
+                        ]:
+                            self._peer_shards[(d["id"], idx_name)] = (
+                                self._peer_shards.pop((nid, idx_name))
+                            )
                     known.id = d["id"]
                 known.is_coordinator = bool(d.get("isCoordinator"))
                 new_nodes.append(known)
@@ -701,9 +707,10 @@ class Cluster:
         shards from stale _peer_shards entries when an index is recreated
         under the same name — and reads would fan out to shards that
         never existed."""
-        self._known_shards.pop(index, None)
-        for key in [k for k in self._peer_shards if k[1] == index]:
-            self._peer_shards.pop(key, None)
+        with self._shard_cache_lock:
+            self._known_shards.pop(index, None)
+            for key in [k for k in self._peer_shards if k[1] == index]:
+                self._peer_shards.pop(key, None)
 
     def _apply_status_inventory(self, node: Node, st: dict) -> None:
         """Adopt the full per-index inventory a /status response carries
@@ -987,23 +994,22 @@ class Cluster:
 
         # iterative deepening: on a skewed (Zipfian) distribution the
         # cutoff drops fast with n', so widening usually proves exactness
-        # in one or two rounds; only a genuinely flat distribution — where
-        # no candidate list can prove anything — pays the exhaustive pass
+        # in one or two rounds. Flat distributions terminate through the
+        # TIE-BREAK argument below instead of an exhaustive pass.
         headroom_n = 2 * n + 10
-        # up to 5 rounds (256× the original headroom) before the
-        # exhaustive pass: each round is two bounded RPCs, while the
-        # exhaustive fallback ships every nonzero row — worth avoiding
-        # on high-cardinality fields whenever the bound can converge
-        for _ in range(5):
+        cnt_n = id_n = None
+        for _ in range(self.TOPN_DEEPEN_ROUNDS):
             headroom = {**call.args, "n": headroom_n}
             phase1 = self._fanout(
                 index, topn_call(headroom), by_node, node_by_id
             )
-            bound = sum(
-                p[-1]["count"] if len(p) >= headroom_n else 0
-                for p in phase1
-                if p
-            )
+            trunc = [p for p in phase1 if p and len(p) >= headroom_n]
+            bound = sum(p[-1]["count"] for p in trunc)
+            # frontier: every truncated node's list ends at (cutoff, fid)
+            # in (count desc, id asc) order. An unseen row reaching the
+            # bound must sit AT the cutoff on every truncated node, i.e.
+            # AFTER each frontier — so its id exceeds every fid.
+            max_fid = max((int(p[-1]["id"]) for p in trunc), default=-1)
             cand = sorted({int(pr["id"]) for p in phase1 for pr in p})
             # bound == 0 ⇒ no node truncated ⇒ each list already carries
             # that node's complete nonzero rows; the merge sums full local
@@ -1019,14 +1025,46 @@ class Cluster:
             for p in phase2:
                 for pr in p:
                     merged[pr["id"]] = merged.get(pr["id"], 0) + pr["count"]
-            exact = sorted(merged.values(), reverse=True)
-            if len(exact) >= n and exact[n - 1] > bound:
-                return phase2
+            exact = sorted(merged.items(), key=lambda rc: (-rc[1], rc[0]))
+            if len(exact) >= n:
+                id_n, cnt_n = exact[n - 1]
+                # an unseen row displaces the n-th candidate only by
+                # (count desc, id asc) order: impossible when its count
+                # ceiling is below cnt_n, and impossible on a TIE when
+                # its id (> max_fid, frontier argument above) cannot
+                # undercut id_n. This is what lets a perfectly flat
+                # distribution — where counts alone never separate —
+                # terminate in one round with bounded transfer.
+                if cnt_n > bound or (
+                    cnt_n == bound and id_n <= max_fid + 1
+                ):
+                    return phase2
             headroom_n *= 4
-        # an unseen row could still tie or beat the n-th candidate: one
-        # exhaustive pass (n stripped — every nonzero row comes back)
-        # settles membership exactly
+        # Bounded final pass (never every nonzero row): a row that could
+        # still displace the current n-th candidate (cnt_n, id_n) needs a
+        # global count ≥ cnt_n, hence a LOCAL count ≥ ceil(cnt_n / P) on
+        # at least one of the P fanned-out nodes. Ask each node for
+        # exactly those rows (minCount floor), recount the union for
+        # exact global counts, and the result is provably complete:
+        # anything never returned has global ≤ P·(ceil(cnt_n/P) − 1)
+        # < cnt_n — strictly below the n-th, no tie possible.
+        if cnt_n is None:
+            # < n distinct rows exist cluster-wide even after deepening:
+            # with every per-node list truncation-free this returns at
+            # bound == 0 above; a populated truncated list at headroom_n
+            # ≥ n implies ≥ n candidates. Unreachable, but fail exact.
+            args = {k: v for k, v in call.args.items() if k != "n"}
+            return self._fanout(index, topn_call(args), by_node, node_by_id)
+        floor = max(1, -(-cnt_n // max(1, len(by_node))))
         args = {k: v for k, v in call.args.items() if k != "n"}
+        args["minCount"] = floor
+        sweep = self._fanout(index, topn_call(args), by_node, node_by_id)
+        cand = sorted(
+            {int(pr["id"]) for p in sweep for pr in p}
+            | {int(pr["id"]) for p in phase2 for pr in p}
+        )
+        args = {k: v for k, v in call.args.items() if k != "n"}
+        args["ids"] = cand
         return self._fanout(index, topn_call(args), by_node, node_by_id)
 
     def wait_rebalanced(self, timeout: float | None = None) -> None:
@@ -1856,10 +1894,17 @@ class Cluster:
             return
         # a pending reconcile (armed at boot / on demotion) upgrades the
         # incremental tail to a full pull — AE runs off the heartbeat
-        # thread, so doing it inline here is fine
-        full = self._translate_reconcile_pending
+        # thread, so doing it inline here is fine. The clear is
+        # generation-guarded like _maybe_reconcile_translations': a
+        # demotion that re-arms pending mid-pull must not be wiped by
+        # this (older) pull's completion.
+        with self._translate_fence_lock:
+            full = self._translate_reconcile_pending
+            gen0 = self._primacy_gen
         if self._pull_translations_from(primary, full=full) and full:
-            self._translate_reconcile_pending = False
+            with self._translate_fence_lock:
+                if self._primacy_gen == gen0:
+                    self._translate_reconcile_pending = False
 
     # ------------------------------------------------------ internal routes
     def _mount_internal_routes(self) -> None:
