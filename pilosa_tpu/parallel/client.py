@@ -48,10 +48,11 @@ class InternalClient:
         path: str,
         body: bytes | None = None,
         timeout: float | None = None,
+        content_type: str = "application/json",
     ) -> bytes:
         req = urllib.request.Request(uri + path, data=body, method=method)
         if body is not None:
-            req.add_header("Content-Type", "application/json")
+            req.add_header("Content-Type", content_type)
         try:
             with urllib.request.urlopen(
                 req,
@@ -81,16 +82,28 @@ class InternalClient:
     # ------------------------------------------------------------ queries
     def query_node(
         self, uri: str, index: str, pql: str, shards: list[int] | None
-    ) -> list[dict]:
-        """Execute PQL on a peer restricted to given shards; returns typed
-        result JSON (reference: InternalClient.QueryNode)."""
-        resp = self._json(
+    ) -> list:
+        """Execute PQL on a peer restricted to given shards; returns the
+        DECODED typed results (reference: InternalClient.QueryNode).
+        Peers respond framed (JSON control + raw packed-word blobs, see
+        encoding/frame.py); the JSON branch below exists for test
+        doubles and non-cluster servers, not version skew — the
+        internal wire assumes a uniform-version cluster."""
+        from pilosa_tpu.encoding import frame
+        from pilosa_tpu.parallel.resultwire import decode_result
+
+        raw = self._request(
             "POST",
             uri,
             "/internal/query",
-            {"index": index, "query": pql, "shards": shards},
+            json.dumps(
+                {"index": index, "query": pql, "shards": shards}
+            ).encode(),
         )
-        return resp["results"]
+        if frame.is_frame(raw):
+            control, blobs = frame.decode_frame(raw)
+            return [decode_result(d, blobs) for d in control["results"]]
+        return [decode_result(d) for d in json.loads(raw)["results"]]
 
     def node_shards(self, uri: str, index: str) -> list[int]:
         resp = self._json("GET", uri, f"/internal/shards?index={index}")
@@ -106,11 +119,34 @@ class InternalClient:
         self, uri: str, index: str, field: str, payload: dict, values: bool
     ) -> list[str]:
         """Deliver one shard slice; returns the URIs that APPLIED it (the
-        receiver may have re-forwarded to the current owners)."""
+        receiver may have re-forwarded to the current owners). The fat id
+        vectors travel as raw u64 blobs (framed; see encoding/frame.py) —
+        a wide import fan-out pays 8 bytes/column, not JSON int text."""
+        from pilosa_tpu.encoding import frame
+
+        control = dict(payload)
+        blobs: list[bytes] = []
+        for key in ("columnIDs", "rowIDs"):
+            v = control.get(key)
+            if v is not None and len(v):
+                control[f"{key}Bin"] = len(blobs)
+                blobs.append(frame.pack_u64(control.pop(key)))
+        vals = control.get("values") if values else None
+        if vals is not None and len(vals):
+            control["valuesBin"] = len(blobs)
+            # values are SIGNED ints (BSI fields)
+            import numpy as np
+
+            blobs.append(np.asarray(control.pop("values"), np.int64).tobytes())
         kind = "import-value" if values else "import"
-        resp = self._json(
-            "POST", uri, f"/internal/{kind}/{index}/{field}", payload
+        raw = self._request(
+            "POST",
+            uri,
+            f"/internal/{kind}/{index}/{field}",
+            frame.encode_frame(control, blobs),
+            content_type=frame.CONTENT_TYPE,
         )
+        resp = json.loads(raw or b"{}")
         applied = resp.get("appliedBy") if isinstance(resp, dict) else None
         return applied if isinstance(applied, list) else [uri]
 
@@ -137,15 +173,24 @@ class InternalClient:
         )
         return {int(b["block"]): b["checksum"] for b in resp["blocks"]}
 
-    def block_data(
-        self, uri: str, index: str, field: str, view: str, shard: int, block: int
-    ) -> tuple[list[int], list[int]]:
-        resp = self._json(
+    def block_data(self, uri: str, index: str, field: str, view: str,
+                   shard: int, block: int):
+        """One AE block's (rows, cols) pairs — framed raw u64 arrays
+        (JSON branch: test doubles / non-cluster servers only)."""
+        from pilosa_tpu.encoding import frame
+
+        raw = self._request(
             "GET",
             uri,
             f"/internal/fragment/block/data?index={index}&field={field}"
             f"&view={view}&shard={shard}&block={block}",
         )
+        if frame.is_frame(raw):
+            control, blobs = frame.decode_frame(raw)
+            if not control.get("n"):
+                return [], []
+            return frame.unpack_u64(blobs[0]), frame.unpack_u64(blobs[1])
+        resp = json.loads(raw)
         return resp["rows"], resp["cols"]
 
     def set_attrs(self, uri: str, payload: dict) -> None:

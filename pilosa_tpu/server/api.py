@@ -269,9 +269,11 @@ class API:
 
     @staticmethod
     def _payload_size(payload: dict) -> int:
+        # `v is not None` (not truthiness): framed internal imports carry
+        # these as ndarrays, whose truth value is ambiguous
         return max(
             (
-                len(payload.get(k) or [])
+                len(v) if (v := payload.get(k)) is not None else 0
                 for k in ("rowIDs", "rowKeys", "columnIDs", "columnKeys", "values")
             ),
             default=0,
