@@ -244,6 +244,12 @@ def _child_main(n_shards: int) -> None:
                 "e2e_p50_ms": round(e2e_p50_ms, 2),
                 "topn_p50_ms": round(topn_p50_ms, 2),
                 "transport_rtt_ms": round(rtt_ms, 1),
+                # tunnel-independent server time: on a tunneled chip the
+                # sync RTT floor (~70 ms in r3) swamps every p50 — the
+                # subtraction makes latency PROGRESS visible across
+                # rounds even when the environment's RTT doesn't move
+                "server_p50_ms": round(max(0.0, e2e_p50_ms - rtt_ms), 2),
+                "topn_server_p50_ms": round(max(0.0, topn_p50_ms - rtt_ms), 2),
                 "hbm_gbps": round(gbps, 1),
             }
         ),

@@ -842,17 +842,37 @@ class Cluster:
             ]
             if not alive_owners:
                 raise ShardUnavailableError(f"no alive owner for shard {s}")
-            # Prefer an owner that actually HOLDS the fragment: mid-resize
-            # a shard's new owner may still be pulling, and routing there
-            # would silently count zeros. The previous holder keeps its
-            # copy until the anti-entropy handoff completes, so falling
-            # back to ANY alive node reporting the shard serves exact
-            # data through the window (reference: ResizeJob serves from
-            # the old assignment until the job completes).
-            primary = next(
-                (n for n in alive_owners if s in holdings[n.id]), None
-            )
-            if primary is None:
+            # Only an owner that actually HOLDS the fragment may serve:
+            # mid-resize a shard's new owner may still be pulling, and
+            # routing there would silently count zeros. The previous
+            # holder keeps its copy until the anti-entropy handoff
+            # completes, so falling back to ANY alive node reporting the
+            # shard serves exact data through the window (reference:
+            # ResizeJob serves from the old assignment until the job
+            # completes).
+            holders = [n for n in alive_owners if s in holdings[n.id]]
+            if holders:
+                # Replica read load-balancing (reference: cluster.go
+                # shardNodes — any replica serves a read). Serve locally
+                # when this node is a holder (a local partial costs no
+                # RPC at all — what makes full replication scale reads
+                # linearly with nodes); otherwise pick a holder by a
+                # PER-SHARD-stable hash: different shards land on
+                # different replicas (aggregate load spreads), while one
+                # shard's reads stay pinned to one replica — alternating
+                # replicas per request would make a replica that missed a
+                # write (owner down at write time, repaired by the next
+                # anti-entropy pass) visible as answers FLAPPING between
+                # values on identical back-to-back queries.
+                local = next(
+                    (n for n in holders if n.id == self.me.id), None
+                )
+                primary = (
+                    local
+                    if local is not None
+                    else holders[(s ^ (s >> 7)) % len(holders)]
+                )
+            else:
                 primary = next(
                     (n for n in read_alive if s in holdings[n.id]),
                     alive_owners[0],
