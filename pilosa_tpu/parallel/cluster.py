@@ -842,14 +842,15 @@ class Cluster:
             ]
             if not alive_owners:
                 raise ShardUnavailableError(f"no alive owner for shard {s}")
-            # Only an owner that actually HOLDS the fragment may serve:
+            # PREFER an owner that actually HOLDS the fragment:
             # mid-resize a shard's new owner may still be pulling, and
             # routing there would silently count zeros. The previous
             # holder keeps its copy until the anti-entropy handoff
             # completes, so falling back to ANY alive node reporting the
             # shard serves exact data through the window (reference:
             # ResizeJob serves from the old assignment until the job
-            # completes).
+            # completes). Last resort — nobody reports the shard at
+            # all — is alive_owners[0], which may still be pulling.
             holders = [n for n in alive_owners if s in holdings[n.id]]
             if holders:
                 # Replica read load-balancing (reference: cluster.go

@@ -275,6 +275,49 @@ class MeshQueryEngine:
         return prog
 
     @functools.cached_property
+    def _tanimoto_prog(self):
+        """(matrix [R,S,W], query [S,W]) → (scores f32[k], ids i32[k]) —
+        BASELINE config 5 (chemical-similarity search) as ONE SPMD
+        program: per-device partial |a∩q| and |a| popcounts, psum over
+        words-then-shards (the words hop rides the fast/ICI minor axis),
+        Tanimoto on the replicated vectors, top_k replicated."""
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(None, AXIS_SHARDS, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            out_specs=(P(), P(), P()),
+        )
+        def counts_prog(matrix, query):
+            inter = jnp.sum(
+                ops.popcount_rows(matrix & query[None]).astype(jnp.int64),
+                axis=1,
+            )
+            row_pop = jnp.sum(
+                ops.popcount_rows(matrix).astype(jnp.int64), axis=1
+            )
+            q_pop = jnp.sum(ops.popcount_rows(query).astype(jnp.int64))
+            red = lambda v: jax.lax.psum(
+                jax.lax.psum(v, AXIS_WORDS), AXIS_SHARDS
+            )
+            return red(inter), red(row_pop), red(q_pop)
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def prog(matrix, query, k: int):
+            inter, row_pop, q_pop = counts_prog(matrix, query)
+            inter = inter.astype(jnp.float32)
+            union = row_pop.astype(jnp.float32) + q_pop.astype(jnp.float32) - inter
+            scores = jnp.where(union > 0, inter / union, 0.0)
+            k = min(k, scores.shape[0])
+            vals, ids = jax.lax.top_k(scores, k)
+            return vals, ids.astype(jnp.int32)
+
+        return prog
+
+    def tanimoto(self, matrix, query, k: int):
+        return self._call("tanimoto", self._tanimoto_prog, matrix, query, k)
+
+    @functools.cached_property
     def _bsi_sum_prog(self):
         """(slices [D,S,W], filt [S,W]) → (sum int64, count int64)."""
 
