@@ -53,6 +53,19 @@ def timeit(fn, iters):
     return (time.perf_counter() - t0) / iters
 
 
+def free_ports(k):
+    """k distinct ephemeral localhost ports (bind-then-release)."""
+    import socket
+
+    socks = [socket.socket() for _ in range(k)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
 def line(metric, value, unit, vs):
     print(
         json.dumps(
@@ -232,6 +245,16 @@ def config3_topn_groupby():
     line("executor_groupby_server_p50_ms",
          max(0.0, gb_p50 - _RTT_MS), "ms", 1.0)
 
+    # pipelined GroupBy, same rationale as the TopN batch above: the
+    # sync number is RTT-floored (~1/RTT through a tunnel) regardless of
+    # device speed; a 10-call request resolves in one _Pending readback
+    # wave, so this is the number where GroupBy progress is visible
+    gql10 = " ".join(
+        ["GroupBy(Rows(cab_type), Rows(passenger_count), limit=100)"] * 10
+    )
+    t_gpipe = timeit(lambda: e.execute("taxi", gql10), 5) / 10
+    line("executor_groupby_pipelined_qps", 1 / t_gpipe, "qps", t_hgb / t_gpipe)
+
 
 def config4_bsi_sum_range():
     import jax
@@ -373,6 +396,41 @@ def config6_ingest():
         1.0,
     )
 
+    # END-TO-END HTTP import-roaring (VERDICT r4: the fast path's number
+    # existed only in notes — capture the full network path: socket →
+    # route dispatch → body read → deserialize → union into storage)
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.utils.config import Config
+
+    port = free_ports(1)[0]
+    srv = Server(Config(bind=f"127.0.0.1:{port}",
+                        data_dir=tempfile.mkdtemp(), seeds=[]))
+    srv.open()
+    try:
+        for path in ("/index/ing3", "/index/ing3/field/f"):
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}", data=b"{}", method="POST"
+            )).read()
+        t0 = time.perf_counter()
+        for sh, data in payloads.items():
+            urllib.request.urlopen(urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/ing3/field/f"
+                f"/import-roaring/{sh}",
+                data=data,
+                method="POST",
+            )).read()
+        line(
+            "ingest_http_roaring_msetbits_per_s",
+            n / (time.perf_counter() - t0) / 1e6,
+            "Mbit/s",
+            1.0,
+        )
+    finally:
+        srv.close()
+
 
 def config7_cluster_read():
     """2-node in-process cluster over real HTTP sockets, replica_n=2:
@@ -382,22 +440,12 @@ def config7_cluster_read():
     with zero internal RPCs on whichever node takes it, so added
     replicas scale read throughput instead of buying failover only
     (VERDICT r4: replica read load-balancing, measured)."""
-    import socket
     import tempfile
     import urllib.request
 
     from pilosa_tpu.server import Server
     from pilosa_tpu.shardwidth import SHARD_WIDTH
     from pilosa_tpu.utils.config import Config
-
-    def free_ports(k):
-        socks = [socket.socket() for _ in range(k)]
-        for s in socks:
-            s.bind(("127.0.0.1", 0))
-        ports = [s.getsockname()[1] for s in socks]
-        for s in socks:
-            s.close()
-        return ports
 
     def call(port, body):
         req = urllib.request.Request(

@@ -877,6 +877,40 @@ class QueryCompiler:
         self._programs: dict[tuple, Callable] = {}
         self._ones: dict[int, Any] = {}
         self._aot: set[tuple] = set()
+        self._scalar_arrays: dict[tuple, Any] = {}
+
+    def device_scalars(self, values: list[int]):
+        """Device-resident int32 operand vector, cached by VALUE.
+
+        Dispatching with a fresh numpy array uploads it host→device on
+        every call; on a tunneled accelerator that upload is a transport
+        round that can dominate the per-query cost of a fully pipelined
+        dispatch (the compute for a 10B-column count is ~3 ms; the
+        operand upload is pure overhead). Repeated queries — the common
+        serving case, and exactly what a QPS benchmark issues — hit this
+        cache and dispatch with zero transfers."""
+        key = tuple(values)
+        cached = self._scalar_arrays.get(key)
+        if cached is None:
+            if len(self._scalar_arrays) >= 4096:
+                # tiny (≤ a few hundred bytes each); drop-all beats LRU
+                # bookkeeping on the hot path, rebuild is one upload
+                self._scalar_arrays.clear()
+            host = np.asarray(key, dtype=np.int32)
+            if self.mesh_ctx is not None:
+                # replicate explicitly (and in ONE placement — not
+                # asarray-then-re-place) so SPMD programs see a committed
+                # sharding instead of inferring one per call
+                cached = jax.device_put(
+                    host,
+                    jax.sharding.NamedSharding(
+                        self.mesh_ctx.mesh, jax.sharding.PartitionSpec()
+                    ),
+                )
+            else:
+                cached = jnp.asarray(host)
+            self._scalar_arrays[key] = cached
+        return cached
 
     def program(self, key: tuple, build: Callable[[], Callable]) -> Callable:
         """Generic compiled-program cache (used by the executor for its
@@ -965,10 +999,8 @@ class QueryCompiler:
             key, lambda: jax.jit(lambda arrays, scalars: run(arrays, scalars))
         )
         arrays = planner.materialize()
-        # numpy, not jnp: a jnp.asarray here is a traced op dispatch per
-        # query (~0.2 ms on CPU); jit converts numpy args at call time
         return self.call_program(
-            key, prog, arrays, np.asarray(planner.scalar_values(), dtype=np.int32)
+            key, prog, arrays, self.device_scalars(planner.scalar_values())
         )
 
     def bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
@@ -990,9 +1022,7 @@ class QueryCompiler:
 
         prog = self.program(key, build)
         arrays = planner.materialize()
-        # numpy, not jnp: a jnp.asarray here is a traced op dispatch per
-        # query (~0.2 ms on CPU); jit converts numpy args at call time
         return self.call_program(
-            key, prog, arrays, np.asarray(planner.scalar_values(), dtype=np.int32)
+            key, prog, arrays, self.device_scalars(planner.scalar_values())
         )
 

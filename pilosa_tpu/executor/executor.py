@@ -401,7 +401,7 @@ class Executor:
         except PlanError as e:
             raise ExecutionError(str(e)) from e
         arrays = planner.materialize()
-        scalars = np.asarray(planner.scalar_values(), dtype=np.int32)
+        scalars = self.compiler.device_scalars(planner.scalar_values())
         return run, arrays, scalars, skey
 
     def _bsi_stacked(self, idx: Index, field: Field, shards: list[int]):
