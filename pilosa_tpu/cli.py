@@ -28,7 +28,18 @@ def _apply_jax_platform_env() -> None:
     if plat:
         import jax
 
-        jax.config.update("jax_platforms", plat)
+        current = jax.config.jax_platforms
+        allowed = {p for p in current.split(",") if p} if current else None
+        wanted = {p for p in plat.split(",") if p}
+        if allowed is None or wanted <= allowed:
+            # the explicit update is what actually defeats a plugin hook
+            # that swallows the env var (a site plugin may have set e.g.
+            # "accel,cpu" — narrowing to the env's "cpu" is what the
+            # operator asked for). But never ADD a platform an
+            # in-process caller excluded: tests/embedders that pinned
+            # "cpu" must not be flipped back to the env's accelerator —
+            # the next backend init would hang on a wedged transport.
+            jax.config.update("jax_platforms", plat)
 
 
 def _base_uri(host: str) -> str:

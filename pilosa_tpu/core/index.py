@@ -16,8 +16,9 @@ from dataclasses import asdict, dataclass
 import numpy as np
 
 from pilosa_tpu.core.attrstore import AttrStore
-from pilosa_tpu.core.field import FIELD_SET, Field, FieldOptions
+from pilosa_tpu.core.field import FIELD_SET, VIEW_STANDARD, Field, FieldOptions
 from pilosa_tpu.core.translate import TranslateStore
+from pilosa_tpu.shardwidth import SHARD_WIDTH
 
 EXISTENCE_FIELD = "_exists"
 
@@ -115,9 +116,24 @@ class Index:
 
     def mark_columns_exist(self, cols: np.ndarray) -> None:
         ef = self.existence_field()
-        if ef is not None and np.asarray(cols).size:
-            cols = np.asarray(cols, dtype=np.uint64)
+        if ef is None or not np.asarray(cols).size:
+            return
+        cols = np.asarray(cols, dtype=np.uint64)
+        from pilosa_tpu.core.fragment import MAX_OP_N
+
+        if cols.size <= MAX_OP_N:  # the fragment's own snapshot threshold
+            # small delta: the bit-list path op-logs it (cheap, durable)
             ef.import_bulk(np.zeros(cols.size, dtype=np.uint64), cols)
+            return
+        # bulk delta (import-roaring scale): a per-shard roaring union
+        # with one snapshot — the bit-list machinery (sort, group,
+        # op-log append, snapshot anyway at this size) is pure overhead
+        view = ef.create_view_if_not_exists(VIEW_STANDARD)
+        shards = cols // np.uint64(SHARD_WIDTH)
+        for sh in np.unique(shards).tolist():
+            frag = view.create_fragment_if_not_exists(int(sh))
+            # existence row is 0: position == in-shard column offset
+            frag.union_positions(cols[shards == sh] % np.uint64(SHARD_WIDTH))
 
     def available_shards(self) -> set[int]:
         shards: set[int] = set()

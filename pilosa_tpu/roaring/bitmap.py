@@ -288,9 +288,23 @@ class Bitmap:
         """All values, sorted ascending, as uint64."""
         if not self._containers:
             return np.empty(0, dtype=np.uint64)
+        keys = sorted(self._containers)
+        conts = [self._containers[k] for k in keys]
+        if all(c.type == ct.TYPE_ARRAY for c in conts):
+            # all-array fast path (the shape of every sparse bulk-load
+            # delta): ONE concat + ONE key-offset broadcast instead of
+            # an astype+add pair per container
+            sizes = np.fromiter(
+                (c.data.size for c in conts), np.int64, len(conts)
+            )
+            vals = np.concatenate([c.data for c in conts]).astype(np.uint64)
+            offs = np.repeat(
+                np.asarray(keys, np.uint64) << np.uint64(_KEY_SHIFT), sizes
+            )
+            return vals + offs
         parts = []
-        for key in sorted(self._containers):
-            vals = ct.as_values(self._containers[key]).astype(np.uint64)
+        for key, c in zip(keys, conts):
+            vals = ct.as_values(c).astype(np.uint64)
             parts.append(vals + (np.uint64(key) << _KEY_SHIFT))
         return np.concatenate(parts)
 

@@ -86,25 +86,45 @@ def serialize(bitmap: Bitmap, compact_in_place: bool = False) -> bytes:
     (e.g. the anti-entropy /fragment/data handler) keep the default
     read-only behavior."""
     keys = sorted(bitmap._containers)
-    buf = io.BytesIO()
-    cookie = MAGIC | (STORAGE_VERSION << 16)
-    buf.write(_PILOSA_HEADER.pack(cookie, len(keys)))
+    # run containers are already compacted; everything else gets the
+    # Optimize pass — batched, one vectorized analysis for the whole
+    # bitmap instead of a numpy micro-call chain per container
+    compacted = ct.batch_optimize([bitmap._containers[k] for k in keys])
     payloads = []
-    for key in keys:
-        c = bitmap._containers[key]
-        if c.type != ct.TYPE_RUN:  # run containers are already compacted
-            c = ct.optimize(c, runs=True)
-            if compact_in_place:
-                bitmap._containers[key] = c
+    counts = np.empty(len(keys), dtype=np.int64)
+    for i, c in enumerate(compacted):
+        if compact_in_place and c is not bitmap._containers[keys[i]]:
+            bitmap._containers[keys[i]] = c
         payloads.append(_payload_bytes(c))
-        buf.write(_PILOSA_META.pack(key, c.type, ct.container_count(c) - 1))
-    offset = _PILOSA_HEADER.size + len(keys) * (_PILOSA_META.size + 4)
-    for payload in payloads:
-        buf.write(struct.pack("<I", offset))
-        offset += len(payload)
-    for payload in payloads:
-        buf.write(payload)
-    return buf.getvalue()
+        counts[i] = ct.container_count(c)
+    # meta + offset blocks as two vectorized tobytes, not a struct.pack
+    # and BytesIO.write per container (<QHH> packs to 12 bytes unpadded,
+    # matching the numpy packed struct dtype)
+    meta = np.empty(
+        len(keys), dtype=[("key", "<u8"), ("type", "<u2"), ("n", "<u2")]
+    )
+    meta["key"] = keys
+    meta["type"] = [c.type for c in compacted]
+    meta["n"] = counts - 1
+    if counts.size and counts.min() <= 0:
+        # an empty container would wrap n-1 through <u2 and corrupt the
+        # stream on read-back; the container layer never stores empties
+        raise ValueError("cannot serialize an empty container")
+    lengths = np.fromiter((len(p) for p in payloads), np.int64, len(payloads))
+    first = _PILOSA_HEADER.size + len(keys) * (_PILOSA_META.size + 4)
+    offsets = first + np.concatenate(([0], np.cumsum(lengths)))[: len(payloads)]
+    if offsets.size and int(offsets[-1]) + int(lengths[-1]) > 0xFFFFFFFF:
+        # the <u4 cast below would silently wrap where struct.pack("<I")
+        # raised — keep the loud failure for >4 GiB snapshots
+        raise ValueError("serialized bitmap exceeds the 4 GiB offset space")
+    return b"".join(
+        [
+            _PILOSA_HEADER.pack(MAGIC | (STORAGE_VERSION << 16), len(keys)),
+            meta.tobytes(),
+            offsets.astype("<u4").tobytes(),
+            *payloads,
+        ]
+    )
 
 
 def serialize_official(bitmap: Bitmap) -> bytes:
@@ -123,12 +143,9 @@ def serialize_official(bitmap: Bitmap) -> bytes:
             f"official roaring format is 32-bit: container key {keys[-1]} "
             "exceeds 0xFFFF (value ≥ 2^32)"
         )
-    conts = []
-    for key in keys:
-        c = bitmap._containers[key]
-        if c.type != ct.TYPE_RUN:
-            c = ct.optimize(c, runs=True)
-        conts.append((key, c))
+    conts = list(
+        zip(keys, ct.batch_optimize([bitmap._containers[k] for k in keys]))
+    )
     n = len(conts)
     has_runs = any(c.type == ct.TYPE_RUN for _k, c in conts)
     buf = io.BytesIO()

@@ -264,8 +264,17 @@ class API:
         idx = self._index(index)
         f = self._field(idx, field)
         frag = f.create_view_if_not_exists(view).create_fragment_if_not_exists(shard)
-        frag.import_roaring(data)
-        idx.mark_columns_exist(frag.bitmap.values() % np.uint64(SHARD_WIDTH) + np.uint64(shard * SHARD_WIDTH))
+        delta = frag.import_roaring(data)
+        # existence marking from the DELTA (incoming positions), not the
+        # merged fragment — a whole-fragment values() pass per import
+        # made repeated bulk loads O(fragment) each (measured 2026-07-31:
+        # the difference between 2.9 and >10 M set-bits/s through the API).
+        # values() under the fragment lock: on the fresh-adopt path the
+        # returned bitmap IS live storage, and a concurrent writer
+        # mutating its containers mid-iteration would throw (or tear)
+        with frag._lock:
+            delta_cols = delta.values() % np.uint64(SHARD_WIDTH)
+        idx.mark_columns_exist(delta_cols + np.uint64(shard * SHARD_WIDTH))
 
     @staticmethod
     def _payload_size(payload: dict) -> int:

@@ -49,6 +49,7 @@ class Server:
         self.diagnostics = None
         self._anti_entropy_timer: threading.Timer | None = None
         self._closed = False
+        self._mesh_attach_thread: threading.Thread | None = None
 
     def open(self) -> None:
         """holder load → HTTP up → cluster join → background loops
@@ -101,7 +102,21 @@ class Server:
                 self.config.process_id if self.config.process_id >= 0 else None,
             )
         if self.config.mesh_enabled:
-            self.api.attach_mesh(self._make_mesh_context())
+            # attach OFF-THREAD: MeshContext.auto's jax.local_devices()
+            # initializes the accelerator backend, and on a tunneled
+            # device a wedged transport hangs that init indefinitely
+            # (observed 2026-07-31: Server.open stuck in
+            # make_c_api_client). Boot must not depend on the
+            # accelerator: ingest/admin/control-plane serve immediately
+            # on the host path; the mesh executor swaps in when (if) the
+            # backend comes up. attach_mesh rebinds whole objects, so
+            # in-flight queries see either the old or the new executor.
+            t = threading.Thread(
+                target=self._attach_mesh_when_ready, daemon=True,
+                name="mesh-attach",
+            )
+            t.start()
+            self._mesh_attach_thread = t
         if self.cluster is not None:
             self.cluster.join()
         self._schedule_anti_entropy()
@@ -110,6 +125,26 @@ class Server:
         self.diagnostics = DiagnosticsCollector(self)
         self.api.diagnostics = self.diagnostics
         self.diagnostics.open()
+
+    def _attach_mesh_when_ready(self) -> None:
+        try:
+            ctx = self._make_mesh_context()
+        except Exception as e:  # noqa: BLE001 — backend init is best-effort
+            self.logger.log(f"mesh attach failed (serving host path): {e}")
+            return
+        if not self._closed:
+            self.api.attach_mesh(ctx)
+
+    def wait_mesh(self, timeout: float | None = None) -> bool:
+        """Block until the off-thread mesh attach finishes (tests and
+        scripted drivers that assert on sharded execution right after
+        open). True when the attach thread is done (attached or failed);
+        False on timeout. No-op truth when mesh was disabled."""
+        t = self._mesh_attach_thread
+        if t is None:
+            return True
+        t.join(timeout)
+        return not t.is_alive()
 
     def _make_mesh_context(self):
         """Serving mesh: always over this process's LOCAL devices — even

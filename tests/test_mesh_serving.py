@@ -31,6 +31,9 @@ def srv(tmp_path):
         )
     )
     s.open()
+    # the mesh executor attaches off-thread (boot must not block on
+    # accelerator init); these tests assert on sharded execution
+    assert s.wait_mesh(60)
     yield s
     s.close()
 
