@@ -599,6 +599,13 @@ def main():
     import subprocess
     import sys
 
+    # honor an explicit JAX_PLATFORMS (e.g. cpu re-measurement of the
+    # host-side configs while the accelerator tunnel is wedged) the same
+    # way the CLI does — the config update is what defeats a site plugin
+    # hook that swallows the env var
+    from pilosa_tpu.cli import _apply_jax_platform_env
+
+    _apply_jax_platform_env()
     child = os.environ.get("PILOSA_BENCH_ALL_CHILD")
     if child == "transport":
         transport_context()

@@ -448,3 +448,51 @@ def test_attrstore_journal_write_amplification(tmp_path):
     s3 = AttrStore(p)
     s3.open()
     assert s3.attrs(0)["c"] == MAX_JOURNAL_OPS - 1
+
+
+def test_mark_columns_exist_bulk_union_path(tmp_holder_path):
+    """Bulk existence marking (> fragment.MAX_OP_N columns) takes the
+    roaring-union fast path; small deltas take the op-logged bit path.
+    Both must agree with the exists-row contents and survive reopen."""
+    h = core.Holder(tmp_holder_path)
+    h.open()
+    idx = h.create_index("i")
+    idx.create_field("f")
+    small = np.array([3, SHARD_WIDTH + 7], dtype=np.uint64)
+    idx.mark_columns_exist(small)
+    bulk = np.arange(2 * SHARD_WIDTH, 2 * SHARD_WIDTH + 5000, dtype=np.uint64)
+    idx.mark_columns_exist(bulk)
+    ef = idx.existence_field()
+    assert ef.view(core.VIEW_STANDARD).fragment(0).contains(0, 3)
+    assert ef.view(core.VIEW_STANDARD).fragment(1).contains(0, 7)
+    frag2 = ef.view(core.VIEW_STANDARD).fragment(2)
+    assert all(frag2.contains(0, int(c)) for c in (0, 2500, 4999))
+    assert not frag2.contains(0, 5000)
+    h.close()
+
+    h2 = core.Holder(tmp_holder_path)
+    h2.open()
+    ef2 = h2.index("i").existence_field()
+    assert ef2.view(core.VIEW_STANDARD).fragment(2).contains(0, 4999)
+    assert ef2.view(core.VIEW_STANDARD).fragment(0).contains(0, 3)
+    h2.close()
+
+
+def test_fragment_union_positions_merges_and_persists(tmp_holder_path):
+    h = core.Holder(tmp_holder_path)
+    h.open()
+    view = h.create_index("u").create_field("f").create_view_if_not_exists(
+        core.VIEW_STANDARD
+    )
+    frag = view.create_fragment_if_not_exists(0)
+    frag.set_bit(1, 10)  # pre-existing bit must survive the union
+    frag.union_positions(np.arange(3000, dtype=np.uint64))  # row 0
+    frag.union_positions(np.array([5, 6], dtype=np.uint64))  # overlap ok
+    assert frag.contains(1, 10) and frag.contains(0, 2999) and frag.contains(0, 5)
+    assert frag.row_count(0) == 3000
+    h.close()
+    h2 = core.Holder(tmp_holder_path)
+    h2.open()
+    frag2 = h2.index("u").field("f").view(core.VIEW_STANDARD).fragment(0)
+    assert frag2.contains(1, 10) and frag2.contains(0, 2999)
+    h2.close()

@@ -417,3 +417,54 @@ def test_serialize_official_fuzz_roundtrip(rng):
         got, consumed = roaring.deserialize(data)
         assert consumed == len(data), f"trial {trial}: trailing bytes"
         assert got == b, f"trial {trial}: contents diverged"
+
+
+def test_batch_optimize_matches_per_container_oracle(rng):
+    """batch_optimize (the vectorized snapshot-serialize pass) must make
+    the EXACT decision optimize(c, runs=True) makes for every container
+    type and density, including the degenerate shapes."""
+    from pilosa_tpu.roaring import containers as ct
+
+    conts = [
+        ct.array_container(np.empty(0, np.uint16)),
+        ct.array_container(np.array([5], np.uint16)),
+        ct.array_container(np.arange(1000, 3000, dtype=np.uint16)),  # run wins
+        ct.run_container(np.array([[0, 10], [20, 30]], np.uint16)),  # untouched
+    ]
+    w_full = np.full(1024, ~np.uint64(0))
+    conts.append(ct.bitmap_container(w_full))  # one 65536-bit run
+    for _ in range(150):
+        kind = int(rng.integers(0, 3))
+        if kind == 0:
+            n = int(rng.integers(0, 4097))
+            conts.append(ct.array_container(
+                np.sort(rng.choice(1 << 16, n, replace=False)).astype(np.uint16)))
+        elif kind == 1:
+            n = int(rng.integers(1, 60000))
+            vv = np.sort(rng.choice(1 << 16, n, replace=False)).astype(np.uint64)
+            ww = np.zeros(1024, np.uint64)
+            ww[vv >> np.uint64(6)] |= np.uint64(1) << (vv & np.uint64(63))
+            conts.append(ct.bitmap_container(ww))
+        else:
+            lo = np.sort(rng.choice(60000, 10, replace=False))
+            conts.append(ct.run_container(np.stack(
+                [lo, lo + rng.integers(0, 100, 10)], axis=1).astype(np.uint16)))
+    batch = ct.batch_optimize(conts)
+    for i, c in enumerate(conts):
+        want = c if c.type == ct.TYPE_RUN else ct.optimize(c, runs=True)
+        assert batch[i].type == want.type, i
+        assert np.array_equal(batch[i].data, want.data), i
+
+
+def test_values_all_array_fast_path_matches_mixed(rng):
+    """Bitmap.values() takes a batched path when every container is an
+    array; it must agree with the generic per-container path."""
+    vals = np.unique(rng.choice(1 << 22, 5000, replace=False).astype(np.uint64))
+    b = roaring.Bitmap.from_values(vals)
+    assert np.array_equal(b.values(), vals)
+    # force a bitmap container into the mix → generic path
+    dense = (np.uint64(7) << np.uint64(16)) + np.arange(6000, dtype=np.uint64)
+    b2 = roaring.Bitmap.from_values(np.unique(np.concatenate([vals, dense])))
+    assert np.array_equal(
+        b2.values(), np.unique(np.concatenate([vals, dense]))
+    )
