@@ -129,3 +129,41 @@ def test_groupby_wide(holder):
     for entry in got:
         rid = entry["group"][0]["rowID"]
         assert entry["count"] == int((ur == rid).sum())
+
+
+def test_width20_import_roaring_snapshot_roundtrip(tmp_path):
+    """Production-width import-roaring: one row spans 16 container keys;
+    the batched snapshot serializer and delta existence marking must hold
+    at 2^20 and survive a disk reopen."""
+    from pilosa_tpu import roaring
+    from pilosa_tpu.server.api import API
+
+    h = Holder(str(tmp_path / "d"))
+    h.open()
+    api = API(h)
+    api.create_index("ir")
+    api.create_field("ir", "f")
+    rng = np.random.default_rng(21)
+    # row 1: a dense run crossing container boundaries; row 3: sparse
+    pos = np.concatenate([
+        np.uint64(1) * SHARD_WIDTH + np.arange(65_000, 70_000, dtype=np.uint64),
+        np.uint64(3) * SHARD_WIDTH
+        + rng.choice(SHARD_WIDTH, 30_000, replace=False).astype(np.uint64),
+    ])
+    bm = roaring.Bitmap()
+    bm.add_many(pos)
+    api.import_roaring("ir", "f", 2, roaring.serialize(bm))
+    e = Executor(h)
+    assert e.execute("ir", "Count(Row(f=1))")[0] == 5000
+    assert e.execute("ir", "Count(Row(f=3))")[0] == 30_000
+    # existence marked from the delta: columns with f=1 but not f=3
+    diff = e.execute("ir", "Count(Difference(Row(f=1), Row(f=3)))")[0]
+    assert 0 < diff <= 5000
+    h.close()
+
+    h2 = Holder(str(tmp_path / "d"))
+    h2.open()
+    e2 = Executor(h2)
+    assert e2.execute("ir", "Count(Row(f=1))")[0] == 5000
+    assert e2.execute("ir", "Count(Row(f=3))")[0] == 30_000
+    h2.close()
