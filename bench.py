@@ -415,7 +415,9 @@ def main() -> None:
             # artifact (bench runs saved when the tunnel was healthy)
             tpu_artifacts = sorted(
                 glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       "BENCH_r*_tpu.json")))
+                                       "BENCH_r*_tpu.json")),
+                key=os.path.getmtime,  # newest capture, not lexicographic
+            )
             if tpu_artifacts:
                 result["last_tpu_artifact"] = os.path.basename(tpu_artifacts[-1])
             best = result
