@@ -26,6 +26,12 @@ import sys as _sys
 # runnable from anywhere: put the repo root on sys.path
 _sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 
+# honor JAX_PLATFORMS even when a site-installed accelerator plugin
+# swallows the env var (same guard the CLI applies)
+from pilosa_tpu.cli import _apply_jax_platform_env  # noqa: E402
+
+_apply_jax_platform_env()
+
 import argparse
 import json
 import os
