@@ -161,3 +161,50 @@ def test_server_concurrent_bulk_imports_and_queries(tmp_path):
     for t in range(N_THREADS):
         assert frag.row_count(t) == 4 * per_batch, t
     srv.close()
+
+
+def test_server_concurrent_import_roaring_and_queries(tmp_path):
+    """import-roaring under concurrency: the fresh-fragment ADOPT path
+    returns the live storage bitmap, and existence marking reads it —
+    concurrent Set() writers on the same fragment must not tear that
+    read (api.import_roaring takes the fragment lock for the delta
+    enumeration). Threads import disjoint rows into ONE shard while
+    others write single bits; final state must equal the serial result."""
+    from pilosa_tpu import roaring
+
+    srv = Server(Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                        anti_entropy_interval=0))
+    srv.open()
+    base = f"http://127.0.0.1:{srv.port}"
+    call("/index/i", b"{}", base=base)
+    call("/index/i/field/f", b"{}", base=base)
+    per_row = 3000  # > MAX_OP_N so existence takes the union path
+
+    def work(t):
+        if t % 2 == 0:
+            # bulk import-roaring of row t (cols t*per_row..)
+            pos = (np.uint64(t) * SHARD_WIDTH
+                   + np.arange(t * per_row, (t + 1) * per_row, dtype=np.uint64))
+            bm = roaring.Bitmap()
+            bm.add_many(pos)
+            call("/index/i/field/f/import-roaring/0", roaring.serialize(bm),
+                 base=base)
+        else:
+            # interleaved single-bit writes on the same fragment
+            for k in range(50):
+                call("/index/i/query",
+                     f"Set({t * 50 + k}, f={t})".encode(), base=base)
+
+    run_threads(work)
+    idx = srv.holder.index("i")
+    frag = idx.field("f").view("standard").fragment(0)
+    for t in range(N_THREADS):
+        want = per_row if t % 2 == 0 else 50
+        assert frag.row_count(t) == want, t
+    # existence covers every imported + set column
+    ef = idx.existence_field().view("standard").fragment(0)
+    for t in range(0, N_THREADS, 2):
+        assert ef.contains(0, t * per_row) and ef.contains(0, (t + 1) * per_row - 1)
+    for t in range(1, N_THREADS, 2):
+        assert ef.contains(0, t * 50)
+    srv.close()
