@@ -24,10 +24,19 @@ class TranslateStore:
         self._by_key: dict[str, int] = {}
         self._by_id: dict[int, str] = {}
         self._next_id = 1  # 0 is reserved (reference never allocates 0)
-        # highest id N such that ids 1..N are ALL present. Replica tailing
-        # must resume from this watermark, not max(_by_id): a hole below
-        # max (a missed primary push) would otherwise never be refilled.
+        # highest id N such that ids 1..N are ALL present — except the
+        # ids listed in _holes. Replica tailing must resume from this
+        # watermark, not max(_by_id): a hole below max (a missed primary
+        # push) would otherwise never be refilled.
         self._dense_through = 0
+        # ids ≤ _dense_through with NO local binding: vacated by a fork
+        # displacement. Tracked explicitly (instead of clamping the
+        # watermark below them) so incremental tailing stays O(new):
+        # a clamped watermark under a permanent hole re-ships the entire
+        # tail above it on EVERY sync pass. Pulls request hole ids
+        # explicitly, so a binding the surviving chain issues for a hole
+        # id later still arrives (see entries_from(holes=...)).
+        self._holes: set[int] = set()
         self._file = None
 
     def open(self) -> None:
@@ -61,14 +70,25 @@ class TranslateStore:
         self._by_key[key] = id_
         self._by_id[id_] = key
         self._next_id = max(self._next_id, id_ + 1)
-        while self._dense_through + 1 in self._by_id:
+        self._holes.discard(id_)  # a late binding fills the gap
+        while (nxt := self._dense_through + 1) in self._by_id or nxt in self._holes:
             self._dense_through += 1
 
     @property
     def dense_through(self) -> int:
-        """Replica tailing cursor: every id ≤ this is present locally."""
+        """Replica tailing cursor: every id ≤ this is present locally,
+        except the ids in holes()."""
         with self._lock:
             return self._dense_through
+
+    def holes(self) -> list[int]:
+        """Ids vacated by fork displacements and not since re-bound. A
+        tailing pull must request the ones at/below its offset
+        explicitly — they are invisible to an `id > offset` scan (the
+        sender ignores requested holes above the offset: the tail scan
+        already covers those)."""
+        with self._lock:
+            return sorted(self._holes)
 
     def translate_key(self, key: str, create: bool = True) -> int | None:
         """key → ID, allocating when ``create`` (reference:
@@ -98,13 +118,22 @@ class TranslateStore:
             return [self._by_id.get(i) for i in ids]
 
     # ------------------------------------------------- replication support
-    def entries_from(self, offset: int) -> tuple[list[tuple[str, int]], int]:
+    def entries_from(
+        self, offset: int, holes: list[int] | None = None
+    ) -> tuple[list[tuple[str, int]], int]:
         """All (key, id) pairs after a cursor for replica tailing
-        (reference: /internal/translate/data streaming)."""
+        (reference: /internal/translate/data streaming). ``holes`` lists
+        ids at/below the caller's cursor that the caller lacks (fork
+        vacancies): any binding this store holds for them is included,
+        since an `id > offset` scan can never deliver those again."""
         with self._lock:
             items = sorted(self._by_id.items())
             tail = [(k, i) for i, k in items if i > offset]
-            return [(k, i) for (k, i) in tail], (items[-1][0] if items else 0)
+            for i in sorted(set(holes or ())):
+                k = self._by_id.get(i)
+                if k is not None and i <= offset:
+                    tail.append((k, i))
+            return tail, (items[-1][0] if items else 0)
 
     def apply_entries(
         self, entries: list[tuple[str, int]]
@@ -144,7 +173,14 @@ class TranslateStore:
             dropped.append((key, old_id))
             if self._by_id.get(old_id) == key:
                 del self._by_id[old_id]
-                # the removal punches a hole: tailing must re-cover it
-                self._dense_through = min(self._dense_through, old_id - 1)
+                # the removal punches a hole: record it (tailing requests
+                # hole ids explicitly; the watermark advance may cross
+                # recorded holes) instead of clamping the watermark — a
+                # permanent fork hole would otherwise pin the watermark
+                # forever and make every incremental sync re-ship the
+                # whole tail above it. Unconditional: a vacancy ABOVE the
+                # watermark would equally block the advance when later
+                # ids fill in around it.
+                self._holes.add(old_id)
         self._apply(key, id_)
         return True

@@ -235,13 +235,39 @@ class InternalClient:
 
     # ------------------------------------------------------- translation
     def translate_entries(
-        self, uri: str, index: str, field: str | None, offset: int
+        self,
+        uri: str,
+        index: str,
+        field: str | None,
+        offset: int,
+        holes: list[int] | None = None,
     ) -> list[tuple[str, int]]:
-        path = f"/internal/translate/data?index={index}&offset={offset}"
-        if field:
-            path += f"&field={field}"
-        resp = self._json("GET", uri, path)
-        return [(e["k"], e["id"]) for e in resp["entries"]]
+        """``holes`` lists ids ≤ offset the caller lacks (fork
+        vacancies); the sender includes its bindings for them — an
+        `id > offset` scan can never re-deliver those. Hole ids travel
+        in the query string, CHUNKED: a mass displacement could
+        otherwise exceed the server's request-line limit and fail the
+        tail permanently. Extra chunks use an offset past any real id so
+        only the requested holes come back."""
+        no_tail = 1 << 62  # ids allocate densely from 1; never reached
+
+        def fetch(off: int, hs: list[int]) -> list[tuple[str, int]]:
+            path = f"/internal/translate/data?index={index}&offset={off}"
+            if field:
+                path += f"&field={field}"
+            if hs:
+                path += "&holes=" + ",".join(str(i) for i in hs)
+            resp = self._json("GET", uri, path)
+            return [(e["k"], e["id"]) for e in resp["entries"]]
+
+        chunk = 512
+        holes = list(holes or ())
+        out = fetch(offset, holes[:chunk])
+        for lo in range(chunk, len(holes), chunk):
+            # hole ids are ≤ the caller's watermark ≤ no_tail, so the
+            # sender's `i <= offset` guard admits every requested id
+            out.extend(fetch(no_tail, holes[lo : lo + chunk]))
+        return out
 
     # --------------------------------------------------------- broadcast
     def remove_node(self, uri: str, node_id: str, node_uri: str | None = None) -> None:

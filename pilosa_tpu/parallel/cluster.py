@@ -96,6 +96,15 @@ class Cluster:
         # get|set race, transiently breaking read-your-writes). Readers
         # stay lock-free: whole-set assignment is atomic.
         self._shard_cache_lock = threading.Lock()
+        # logical clock over announce applications: a heartbeat /status
+        # snapshot is fetched at some clock reading c0, and an announce
+        # for (node, index) stamped AFTER c0 proves the snapshot may
+        # predate that announce — replacing the set from it would wipe a
+        # just-announced holding (lost update → a read routed to a
+        # still-pulling owner silently counts zeros). Such entries skip
+        # the replace; the next heartbeat heals.
+        self._inv_clock = 0
+        self._announce_stamp: dict[tuple[str, str], int] = {}
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
@@ -118,6 +127,13 @@ class Cluster:
         # fence that straddles a transition must not stamp itself valid
         self._primacy_gen = 0
         self._reconcile_thread: threading.Thread | None = None
+        # allocations whose replicate-before-ack push FAILED, keyed by
+        # (index, field): the ack was refused, but the local store keeps
+        # the binding — a client retry would otherwise find the keys
+        # bound, skip the push, and ack an allocation no peer holds.
+        # Every subsequent allocation on the store re-pushes these first.
+        self._unpushed_translate: dict[tuple[str, str | None], dict[str, int]] = {}
+        self._unpushed_lock = threading.Lock()
 
     # ------------------------------------------------------------ membership
     @property
@@ -338,6 +354,8 @@ class Cluster:
         # may be its `name` while peers know it by host:port).
         best: tuple[int, list[dict]] | None = None
         for n in self._peers(alive_only=False):
+            with self._shard_cache_lock:  # consistent vs in-flight stamps
+                c0 = self._inv_clock  # BEFORE the fetch
             try:
                 st = self.client.status(n.uri, timeout=5.0)
                 n.alive = True
@@ -345,7 +363,7 @@ class Cluster:
                 n.alive = False
                 degraded = True
                 continue
-            self._apply_status_inventory(n, st)
+            self._apply_status_inventory(n, st, c0)
             ep = st.get("topologyEpoch")
             peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
             if not isinstance(ep, int) or not peer_nodes:
@@ -714,25 +732,50 @@ class Cluster:
             self._known_shards.pop(index, None)
             for key in [k for k in self._peer_shards if k[1] == index]:
                 self._peer_shards.pop(key, None)
+            # drop the announce stamps too: a stale stamp on a recreated
+            # same-name index would suppress heartbeat inventory adoption
+            # until some unrelated announce bumps the clock
+            for key in [k for k in self._announce_stamp if k[1] == index]:
+                self._announce_stamp.pop(key, None)
 
-    def _apply_status_inventory(self, node: Node, st: dict) -> None:
+    def _apply_status_inventory(
+        self, node: Node, st: dict, clock0: int | None = None
+    ) -> None:
         """Adopt the full per-index inventory a /status response carries
         (heartbeat-time repair for any announce either side missed).
         Whole-set ASSIGNMENT, never in-place mutation — concurrent reads
-        iterate these sets lock-free."""
+        iterate these sets lock-free. ``clock0`` is the announce-clock
+        reading taken BEFORE the /status fetch: an entry stamped at or
+        after it proves an announce raced the fetch, so the snapshot may
+        be stale for that (node, index) — skip it rather than wipe the
+        just-announced holding (the next heartbeat heals)."""
         inv = st.get("shards")
         if not isinstance(inv, dict):
             return
-        for idx_name, sh in inv.items():
-            self._peer_shards[(node.id, idx_name)] = set(sh)
+        with self._shard_cache_lock:
+            for idx_name, sh in inv.items():
+                key = (node.id, idx_name)
+                # strictly greater: stamps post-increment the clock, so
+                # an announce applied BEFORE the clock was read carries
+                # stamp <= clock0 and the (later-fetched) snapshot is
+                # fresher than it — skipping on equality would suppress
+                # adoption forever in a quiescent cluster
+                if (
+                    clock0 is not None
+                    and self._announce_stamp.get(key, -1) > clock0
+                ):
+                    continue
+                self._peer_shards[key] = set(sh)
 
     def _refresh_peer_shards(self, node: Node) -> None:
         """One status round-trip to re-pull a peer's inventory."""
+        with self._shard_cache_lock:
+            c0 = self._inv_clock
         try:
             st = self.client.status(node.uri, timeout=5.0)
         except PeerError:
             return
-        self._apply_status_inventory(node, st)
+        self._apply_status_inventory(node, st, c0)
 
     def _announce_shards(
         self, index: str, entries: dict[str, list[int]], replace: bool = False
@@ -760,11 +803,13 @@ class Cluster:
         # same sets lock-free — set replacement is atomic, mutation isn't
         index = payload["index"]
         with self._shard_cache_lock:
+            self._inv_clock += 1
             for uri, sh in payload.get("entries", {}).items():
                 node = next((x for x in self.nodes if x.uri == uri), None)
                 if node is None or node.id == self.me.id:
                     continue  # local truth comes from the holder
                 key = (node.id, index)
+                self._announce_stamp[key] = self._inv_clock
                 if payload.get("replace"):
                     self._peer_shards[key] = set(sh)
                 else:
@@ -1559,12 +1604,33 @@ class Cluster:
         pre = store.translate_keys(keys, create=False)
         miss = {k for k, i in zip(keys, pre) if i is None}
         ids = store.translate_keys(keys, create=True)
-        if miss:
-            new = {}
-            for k, i in zip(keys, ids):
-                if k in miss and i is not None:
-                    new[k] = i
-            self._push_translate_entries(index, field, sorted(new.items()))
+        new = {
+            k: i for k, i in zip(keys, ids) if k in miss and i is not None
+        }
+        # fold in any binding whose earlier push failed (the client was
+        # refused, but the local store kept it): a retry's keys are
+        # already bound, so without this the push would be skipped and
+        # the ack would cover an allocation no peer holds
+        skey = (index, field)
+        with self._unpushed_lock:
+            pending = dict(self._unpushed_translate.get(skey, {}))
+        pending.update(new)
+        if pending:
+            try:
+                self._push_translate_entries(index, field, sorted(pending.items()))
+            except Exception:
+                # any failure means the ack must not go out AND the
+                # bindings must be remembered for the retry's re-push
+                with self._unpushed_lock:
+                    self._unpushed_translate.setdefault(skey, {}).update(pending)
+                raise
+            with self._unpushed_lock:
+                cur = self._unpushed_translate.get(skey)
+                if cur:
+                    for k in pending:
+                        cur.pop(k, None)
+                    if not cur:
+                        self._unpushed_translate.pop(skey, None)
         return ids
 
     def _push_translate_entries(
@@ -1590,9 +1656,16 @@ class Cluster:
 
         def push(peer: Node) -> str | None:
             try:
-                self.client._json(
+                resp = self.client._json(
                     "POST", peer.uri, "/internal/translate/apply", payload
                 )
+                if resp.get("applied") is not True:
+                    # the receiver doesn't know the index/field yet (the
+                    # schema broadcast raced the push): it did NOT store
+                    # the entries, so counting this as replicated would
+                    # ack an allocation no peer holds — refuse; the
+                    # client retries once the schema lands
+                    return f"{peer.uri}: schema not applied on receiver yet"
                 return None
             except PeerError as e:
                 # a REAL probe, not the cached flag: only a peer that is
@@ -1666,6 +1739,16 @@ class Cluster:
                 )
             with self._translate_fence_lock:
                 if self._primacy_gen == gen0:
+                    # the gen guard catches transitions the heartbeat
+                    # OBSERVED; re-derive primacy from current liveness
+                    # too — a demotion seen by liveness flags but whose
+                    # gen bump raced this attempt must not stamp a fence
+                    # for a node that is no longer primary
+                    if self._translate_primary().id != self.me.id:
+                        raise ShardUnavailableError(
+                            "translate primacy lost mid-fence; "
+                            "allocation refused — retry"
+                        )
                     self._translate_fence_ok = True
                     self._observed_primary_id = self.me.id
                     return
@@ -1692,6 +1775,7 @@ class Cluster:
                     entries = self.client.translate_entries(
                         node.uri, idx_name, f_name,
                         0 if full else store.dense_through,
+                        holes=None if full else store.holes(),
                     )
                 except PeerError:
                     ok = False
@@ -2257,7 +2341,10 @@ class Cluster:
         store = (
             idx.field(p["field"][0]).row_keys if "field" in p else idx.column_keys
         )
-        entries, _last = store.entries_from(offset)
+        holes = [
+            int(x) for x in p.get("holes", [""])[0].split(",") if x
+        ]
+        entries, _last = store.entries_from(offset, holes=holes)
         handler._json({"entries": [{"k": k, "id": i} for k, i in entries]})
 
     def _h_translate_create(self, handler) -> None:

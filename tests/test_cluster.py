@@ -11,6 +11,7 @@ import urllib.request
 import numpy as np
 import pytest
 
+from pilosa_tpu.parallel.client import PeerError
 from pilosa_tpu.parallel.topology import Topology, Node, partition
 from pilosa_tpu.server import Server
 from pilosa_tpu.shardwidth import SHARD_WIDTH
@@ -1757,3 +1758,85 @@ def test_replica_reads_spread_remote_holders(tmp_path):
         assert len(rpcs) == 6, rpcs  # 3 requests × the same 2 nodes
     finally:
         shutdown(servers)
+
+
+def test_translate_failed_push_repushes_on_retry(tmp_path):
+    """A replication push that fails to an ALIVE peer refuses the ack,
+    but the local store keeps the binding — the client's RETRY finds the
+    keys already bound, and must STILL re-push them (a skipped re-push
+    would ack an allocation no peer holds, un-fencing a later failover)."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/k", {"options": {"keys": True}})
+        pi = _find_primary(servers)
+        cl = servers[pi].cluster
+        real_json = cl.client._json
+        fail = {"on": True}
+
+        def flaky(method, uri, path, *a, **kw):
+            if fail["on"] and path == "/internal/translate/apply":
+                raise PeerError(uri, "injected push failure")
+            return real_json(method, uri, path, *a, **kw)
+
+        cl.client._json = flaky
+        try:
+            with pytest.raises(Exception):
+                call(ports[pi], "POST", "/internal/translate/create",
+                     {"index": "k", "keys": ["dave"]})
+        finally:
+            cl.client._json = real_json
+        fail["on"] = False
+        # local store kept the binding even though the ack was refused
+        p_store = servers[pi].holder.index("k").column_keys
+        did = p_store.translate_key("dave", create=False)
+        assert did is not None
+        # retry: keys are pre-bound, but the push must happen anyway
+        got = call(ports[pi], "POST", "/internal/translate/create",
+                   {"index": "k", "keys": ["dave"]})["ids"][0]
+        assert got == did
+        for i in range(3):
+            if i == pi:
+                continue
+            peer_store = servers[i].holder.index("k").column_keys
+            assert peer_store.translate_key("dave", create=False) == did, (
+                f"node {i} missed the re-push"
+            )
+    finally:
+        shutdown(servers)
+
+
+def test_translate_store_hole_tailing_stays_o_new():
+    """A fork displacement vacates an id below the dense watermark. The
+    watermark must NOT clamp below the hole forever (that makes every
+    incremental sync re-ship the whole tail); instead the hole is
+    tracked, tailing requests it explicitly, and a late binding the
+    chain issues for that id still arrives."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    a = TranslateStore()
+    a.open()
+    for k in ("k1", "k2", "k3", "k4"):
+        a.translate_key(k)  # ids 1..4
+    assert a.dense_through == 4 and a.holes() == []
+    # chain says k2 -> 9: local (k2, 2) is displaced, id 2 becomes a hole
+    dropped = a.apply_entries([("k2", 9)])
+    assert ("k2", 2) in dropped
+    assert a.holes() == [2]
+    # the watermark may advance ACROSS the hole as later ids fill in
+    a.apply_entries([("k5", 5), ("k6", 6), ("k7", 7), ("k8", 8)])
+    assert a.dense_through == 9, a.dense_through
+    # incremental tail ships O(new): nothing above 9 on the source side
+    src = TranslateStore()
+    src.open()
+    src.apply_entries([("k%d" % i, i) for i in range(1, 9) if i != 2])
+    src.apply_entries([("k2", 9)])
+    entries, _ = src.entries_from(a.dense_through, holes=a.holes())
+    assert entries == [], entries  # no spurious full-tail reship
+    # the chain later issues the hole id to a brand-new key: an
+    # id>offset scan can never deliver it, the holes request must
+    src.apply_entries([("late", 2)])
+    entries, _ = src.entries_from(a.dense_through, holes=a.holes())
+    assert entries == [("late", 2)], entries
+    a.apply_entries(entries)
+    assert a.holes() == []
+    assert a.translate_key("late", create=False) == 2
