@@ -1840,3 +1840,23 @@ def test_translate_store_hole_tailing_stays_o_new():
     a.apply_entries(entries)
     assert a.holes() == []
     assert a.translate_key("late", create=False) == 2
+
+
+def test_translate_store_hole_above_watermark():
+    """A displacement can vacate an id ABOVE the dense watermark (a
+    sparsely-applied push binding). The vacancy must be recorded as a
+    hole too, or the watermark sticks below it forever once the ids
+    around it fill in — the same O(tail) re-ship bug one level up."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    a = TranslateStore()
+    a.open()
+    a.apply_entries([("k1", 1), ("k2", 2)])          # dense: watermark 2
+    a.apply_entries([("sparse", 9)])                 # above the watermark
+    assert a.dense_through == 2
+    # chain rebinds "sparse" to id 12: id 9 is vacated above the cursor
+    a.apply_entries([("sparse", 12)])
+    assert 9 in a.holes()
+    # the surrounding ids fill in; the watermark crosses the hole
+    a.apply_entries([(f"k{i}", i) for i in (3, 4, 5, 6, 7, 8, 10, 11)])
+    assert a.dense_through == 12, a.dense_through
