@@ -127,13 +127,33 @@ class TranslateStore:
         vacancies): any binding this store holds for them is included,
         since an `id > offset` scan can never deliver those again."""
         with self._lock:
-            items = sorted(self._by_id.items())
-            tail = [(k, i) for i, k in items if i > offset]
+            span = self._next_id - 1 - offset
+            if 0 < span <= 4 * len(self._by_id):
+                # dense-allocation common case: walking (offset, next_id)
+                # is O(tail) — sorting the whole map made every
+                # incremental heartbeat sync O(n log n) in keyspace size
+                tail = []
+                for i in range(offset + 1, self._next_id):
+                    k = self._by_id.get(i)
+                    if k is not None:
+                        tail.append((k, i))
+            elif span > 0:
+                # a sparse high push binding jumped next_id far past the
+                # held ids: scanning the gap would be O(next_id), worse
+                # than sorting what we actually hold
+                tail = [
+                    (k, i)
+                    for i, k in sorted(self._by_id.items())
+                    if i > offset
+                ]
+            else:
+                tail = []
             for i in sorted(set(holes or ())):
-                k = self._by_id.get(i)
-                if k is not None and i <= offset:
-                    tail.append((k, i))
-            return tail, (items[-1][0] if items else 0)
+                if i <= offset:
+                    k = self._by_id.get(i)
+                    if k is not None:
+                        tail.append((k, i))
+            return tail, (self._next_id - 1 if self._by_id else 0)
 
     def apply_entries(
         self, entries: list[tuple[str, int]]
