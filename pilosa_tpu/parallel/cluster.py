@@ -1614,6 +1614,25 @@ class Cluster:
         skey = (index, field)
         with self._unpushed_lock:
             pending = dict(self._unpushed_translate.get(skey, {}))
+        if pending:
+            # drop entries the store no longer backs: a binding recorded
+            # here before a demotion may have been DISPLACED by the
+            # surviving chain during reconcile — re-pushing it after a
+            # re-promotion would overwrite the chain's legitimate binding
+            # on every peer (apply is incoming-wins)
+            stale = [
+                k for k, i in pending.items()
+                if store.translate_key(k, create=False) != i
+            ]
+            if stale:
+                with self._unpushed_lock:
+                    cur = self._unpushed_translate.get(skey)
+                    for k in stale:
+                        pending.pop(k, None)
+                        if cur:
+                            cur.pop(k, None)
+                    if cur is not None and not cur:
+                        self._unpushed_translate.pop(skey, None)
         pending.update(new)
         if pending:
             try:

@@ -1860,3 +1860,34 @@ def test_translate_store_hole_above_watermark():
     # the surrounding ids fill in; the watermark crosses the hole
     a.apply_entries([(f"k{i}", i) for i in (3, 4, 5, 6, 7, 8, 10, 11)])
     assert a.dense_through == 12, a.dense_through
+
+
+def test_translate_unpushed_stale_binding_not_repushed(tmp_path):
+    """An unpushed binding recorded before a demotion can be DISPLACED
+    by the surviving chain during reconcile; a later allocation on this
+    node must not re-push the stale binding (incoming-wins apply would
+    overwrite the chain's legitimate one on every peer)."""
+    servers, ports, _ = make_cluster(tmp_path, n=3)
+    try:
+        call(ports[0], "POST", "/index/k", {"options": {"keys": True}})
+        pi = _find_primary(servers)
+        cl = servers[pi].cluster
+        store = servers[pi].holder.index("k").column_keys
+        # a binding that was later displaced: store says ghost -> 7
+        store.apply_entries([("ghost", 7)])
+        # ...but the unpushed record still carries the pre-displacement id
+        cl._unpushed_translate[("k", None)] = {"ghost": 3}
+        got = call(ports[pi], "POST", "/internal/translate/create",
+                   {"index": "k", "keys": ["fresh"]})["ids"][0]
+        assert got is not None
+        # the stale record is gone and no peer learned ghost -> 3
+        assert ("k", None) not in cl._unpushed_translate or (
+            "ghost" not in cl._unpushed_translate[("k", None)]
+        )
+        for i in range(3):
+            if i == pi:
+                continue
+            peer = servers[i].holder.index("k").column_keys
+            assert peer.translate_key("ghost", create=False) != 3, i
+    finally:
+        shutdown(servers)
