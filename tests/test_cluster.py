@@ -1891,3 +1891,34 @@ def test_translate_unpushed_stale_binding_not_repushed(tmp_path):
             assert peer.translate_key("ghost", create=False) != 3, i
     finally:
         shutdown(servers)
+
+
+def test_status_snapshot_does_not_wipe_racing_announce(tmp_path):
+    """A /status snapshot fetched at clock c0 must not replace the
+    inventory for a (node, index) an announce touched AFTER c0 — the
+    snapshot may predate the announce, and adopting it would wipe a
+    just-announced holding (read routed to a still-pulling owner)."""
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        cl0, cl1 = servers[0].cluster, servers[1].cluster
+        n1 = next(n for n in cl0.nodes if n.id == cl1.me.id)
+        # snapshot of node1's CURRENT (empty-ish) inventory, clock c0
+        st_stale = {"shards": {"i": []}}
+        with cl0._shard_cache_lock:
+            c0 = cl0._inv_clock
+        # an announce lands AFTER c0: node1 now holds shard 3
+        cl0._apply_shard_entries(
+            {"index": "i", "entries": {cl1.me.uri: [3]}}
+        )
+        assert 3 in cl0._peer_shards[(n1.id, "i")]
+        # applying the stale snapshot with clock0=c0 must NOT wipe it
+        cl0._apply_status_inventory(n1, st_stale, c0)
+        assert 3 in cl0._peer_shards[(n1.id, "i")], "announce wiped"
+        # a snapshot fetched AFTER the announce (fresh clock) does apply
+        with cl0._shard_cache_lock:
+            c1 = cl0._inv_clock
+        cl0._apply_status_inventory(n1, {"shards": {"i": [3, 4]}}, c1)
+        assert cl0._peer_shards[(n1.id, "i")] == {3, 4}
+    finally:
+        shutdown(servers)
