@@ -429,8 +429,23 @@ def config6_ingest():
             "Mbit/s",
             1.0,
         )
+        data_dir = srv.config.data_dir
     finally:
         srv.close()
+
+    # checkpoint/resume: reopen the persisted holder from disk (snapshot
+    # deserialize + ops-log replay — the reference's holder.Open startup
+    # path; SURVEY row 19's perf face)
+    t0 = time.perf_counter()
+    h3 = Holder(data_dir)
+    h3.open()
+    line(
+        "holder_reopen_msetbits_per_s",
+        n / (time.perf_counter() - t0) / 1e6,
+        "Mbit/s",
+        1.0,
+    )
+    h3.close()
 
 
 def config7_cluster_read():
