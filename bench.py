@@ -413,10 +413,17 @@ def main() -> None:
             result["error"] = f"accelerator unavailable ({last_err}); cpu fallback"
             # point the reader at the newest manually-captured real-chip
             # artifact (bench runs saved when the tunnel was healthy)
+            # zero-padded round names sort lexicographically; attempts
+            # (intermediate captures kept for comparison) are excluded so
+            # the pointer lands on the round's final artifact. mtime is
+            # NOT a usable key — a fresh clone writes every file at
+            # checkout time in arbitrary order.
             tpu_artifacts = sorted(
-                glob.glob(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                       "BENCH_r*_tpu.json")),
-                key=os.path.getmtime,  # newest capture, not lexicographic
+                f
+                for f in glob.glob(
+                    os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "BENCH_r*_tpu.json"))
+                if "attempt" not in os.path.basename(f)
             )
             if tpu_artifacts:
                 result["last_tpu_artifact"] = os.path.basename(tpu_artifacts[-1])

@@ -118,9 +118,54 @@ class TranslateStore:
             return [self._by_id.get(i) for i in ids]
 
     # ------------------------------------------------- replication support
+    def adopt_holes(self, ids: list[int]) -> None:
+        """Adopt a SENDER's known holes (fork vacancies) for ids this
+        store has no binding for. Without this, a node that never saw
+        the displacement locally — e.g. one that full-pulled after the
+        fork — has its watermark stuck below the cluster-wide vacancy
+        and re-ships the whole tail above it on every sync."""
+        with self._lock:
+            for i in ids:
+                if i not in self._by_id:
+                    self._holes.add(i)
+            while (nxt := self._dense_through + 1) in self._by_id or (
+                nxt in self._holes
+            ):
+                self._dense_through += 1
+
+    def forget_holes(self, ids: list[int]) -> None:
+        """Drop holes the PRIMARY confirmed vacant (it lacks a binding
+        too and its counter is past them): no chain binding can ever
+        arrive for these, so re-requesting them on every pull is pure
+        overhead. The watermark stays where it is — the ids remain
+        tombstoned vacancies, just no longer worth asking about."""
+        with self._lock:
+            for i in ids:
+                self._holes.discard(i)
+
+    def tail_for(
+        self, offset: int, requested_holes: list[int] | None = None
+    ) -> tuple[list[tuple[str, int]], list[int], list[int]]:
+        """The full tailing answer: (entries, own_holes, vacant).
+        ``entries`` are bindings with id > offset plus any binding held
+        for a requested hole id; ``own_holes`` are this store's known
+        vacancies (for the puller to adopt); ``vacant`` are the
+        requested hole ids this store ALSO lacks AND its counter has
+        already passed — from the primary that is proof no chain binding
+        can ever arrive for them (ids allocate forward only)."""
+        entries = self.entries_from(offset, holes=requested_holes)
+        with self._lock:
+            own = sorted(self._holes)
+            vacant = [
+                i
+                for i in (requested_holes or ())
+                if i not in self._by_id and i < self._next_id
+            ]
+        return entries, own, vacant
+
     def entries_from(
         self, offset: int, holes: list[int] | None = None
-    ) -> tuple[list[tuple[str, int]], int]:
+    ) -> list[tuple[str, int]]:
         """All (key, id) pairs after a cursor for replica tailing
         (reference: /internal/translate/data streaming). ``holes`` lists
         ids at/below the caller's cursor that the caller lacks (fork
@@ -153,7 +198,7 @@ class TranslateStore:
                     k = self._by_id.get(i)
                     if k is not None:
                         tail.append((k, i))
-            return tail, (self._next_id - 1 if self._by_id else 0)
+            return tail
 
     def apply_entries(
         self, entries: list[tuple[str, int]]

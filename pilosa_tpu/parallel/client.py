@@ -249,25 +249,46 @@ class InternalClient:
         otherwise exceed the server's request-line limit and fail the
         tail permanently. Extra chunks use an offset past any real id so
         only the requested holes come back."""
+        entries, _sh, _vac = self.translate_tail(uri, index, field, offset, holes)
+        return entries
+
+    def translate_tail(
+        self,
+        uri: str,
+        index: str,
+        field: str | None,
+        offset: int,
+        holes: list[int] | None = None,
+    ) -> tuple[list[tuple[str, int]], list[int], list[int]]:
+        """Full tailing answer: (entries, sender_holes, vacant) — the
+        sender's own known vacancies (for the puller to adopt) and the
+        requested hole ids the sender also lacks (tombstone candidates
+        when the sender is the primary)."""
         no_tail = 1 << 62  # ids allocate densely from 1; never reached
 
-        def fetch(off: int, hs: list[int]) -> list[tuple[str, int]]:
+        def fetch(off: int, hs: list[int]):
             path = f"/internal/translate/data?index={index}&offset={off}"
             if field:
                 path += f"&field={field}"
             if hs:
                 path += "&holes=" + ",".join(str(i) for i in hs)
             resp = self._json("GET", uri, path)
-            return [(e["k"], e["id"]) for e in resp["entries"]]
+            return (
+                [(e["k"], e["id"]) for e in resp["entries"]],
+                resp.get("senderHoles", []),
+                resp.get("vacant", []),
+            )
 
         chunk = 512
         holes = list(holes or ())
-        out = fetch(offset, holes[:chunk])
+        entries, sender_holes, vacant = fetch(offset, holes[:chunk])
         for lo in range(chunk, len(holes), chunk):
             # hole ids are ≤ the caller's watermark ≤ no_tail, so the
             # sender's `i <= offset` guard admits every requested id
-            out.extend(fetch(no_tail, holes[lo : lo + chunk]))
-        return out
+            e2, _sh2, v2 = fetch(no_tail, holes[lo : lo + chunk])
+            entries.extend(e2)
+            vacant.extend(v2)
+        return entries, sender_holes, vacant
 
     # --------------------------------------------------------- broadcast
     def remove_node(self, uri: str, node_id: str, node_uri: str | None = None) -> None:

@@ -458,6 +458,18 @@ class Cluster:
                             self._peer_shards[(d["id"], idx_name)] = (
                                 self._peer_shards.pop((nid, idx_name))
                             )
+                        # the announce stamps guard those same entries:
+                        # left under the old id, a just-announced holding
+                        # would lose its race protection (and the old-id
+                        # stamps would leak)
+                        for (nid, idx_name) in [
+                            k
+                            for k in self._announce_stamp
+                            if k[0] == known.id
+                        ]:
+                            self._announce_stamp[(d["id"], idx_name)] = (
+                                self._announce_stamp.pop((nid, idx_name))
+                            )
                     known.id = d["id"]
                 known.is_coordinator = bool(d.get("isCoordinator"))
                 new_nodes.append(known)
@@ -1650,6 +1662,20 @@ class Cluster:
                         cur.pop(k, None)
                     if not cur:
                         self._unpushed_translate.pop(skey, None)
+            # TOCTOU corrective: a concurrent reconcile pull can displace
+            # a binding BETWEEN the stale filter and the push — the push
+            # then re-spread a binding the chain had already superseded.
+            # Re-check afterwards and push the store's CURRENT bindings
+            # for anything that moved, so peers converge on the chain's
+            # side within this same ack.
+            corrected = sorted(
+                (k, now)
+                for k, i in pending.items()
+                if (now := store.translate_key(k, create=False)) is not None
+                and now != i
+            )
+            if corrected:
+                self._push_translate_entries(index, field, corrected)
         return ids
 
     def _push_translate_entries(
@@ -1791,7 +1817,7 @@ class Cluster:
                     stores.append((f_name, f.row_keys))
             for f_name, store in stores:
                 try:
-                    entries = self.client.translate_entries(
+                    entries, sender_holes, vacant = self.client.translate_tail(
                         node.uri, idx_name, f_name,
                         0 if full else store.dense_through,
                         holes=None if full else store.holes(),
@@ -1800,6 +1826,17 @@ class Cluster:
                     ok = False
                     continue
                 dropped = store.apply_entries(entries)
+                # adopt the sender's known fork vacancies so this node's
+                # watermark can cross cluster-wide holes it never saw
+                # displaced locally (else every later incremental pull
+                # re-ships the whole tail above the hole)
+                if sender_holes:
+                    store.adopt_holes(sender_holes)
+                if vacant and node.id == self._translate_primary().id:
+                    # the PRIMARY also lacks these requested hole ids and
+                    # its counter is past them — no chain binding can
+                    # ever arrive; stop re-requesting them forever
+                    store.forget_holes(vacant)
                 if dropped:
                     self.server.logger.log(
                         f"translate {idx_name}/{f_name or '<columns>'}: "
@@ -2354,17 +2391,32 @@ class Cluster:
         index = p["index"][0]
         offset = int(p.get("offset", ["0"])[0])
         idx = self.server.holder.index(index)
-        if idx is None:
-            handler._json({"entries": []})
+        store = None
+        if idx is not None:
+            if "field" in p:
+                f = idx.field(p["field"][0])
+                store = f.row_keys if f is not None else None
+            else:
+                store = idx.column_keys
+        if store is None:
+            # unknown index OR field (schema broadcast raced the pull):
+            # empty answer, same as the index-missing case — a 500 here
+            # fails the caller's fence for a transient race
+            handler._json({"entries": [], "senderHoles": [], "vacant": []})
             return
-        store = (
-            idx.field(p["field"][0]).row_keys if "field" in p else idx.column_keys
-        )
         holes = [
             int(x) for x in p.get("holes", [""])[0].split(",") if x
         ]
-        entries, _last = store.entries_from(offset, holes=holes)
-        handler._json({"entries": [{"k": k, "id": i} for k, i in entries]})
+        entries, own_holes, vacant = store.tail_for(offset, holes)
+        handler._json({
+            "entries": [{"k": k, "id": i} for k, i in entries],
+            # the sender's known vacancies: the puller adopts the ones it
+            # lacks so its watermark can cross cluster-wide fork holes
+            "senderHoles": own_holes,
+            # requested holes this store ALSO lacks — from the primary,
+            # proof the binding can never arrive (tombstone the request)
+            "vacant": vacant,
+        })
 
     def _h_translate_create(self, handler) -> None:
         """Batch key→ID translation on the primary. JSON body or a

@@ -1830,12 +1830,12 @@ def test_translate_store_hole_tailing_stays_o_new():
     src.open()
     src.apply_entries([("k%d" % i, i) for i in range(1, 9) if i != 2])
     src.apply_entries([("k2", 9)])
-    entries, _ = src.entries_from(a.dense_through, holes=a.holes())
+    entries = src.entries_from(a.dense_through, holes=a.holes())
     assert entries == [], entries  # no spurious full-tail reship
     # the chain later issues the hole id to a brand-new key: an
     # id>offset scan can never deliver it, the holes request must
     src.apply_entries([("late", 2)])
-    entries, _ = src.entries_from(a.dense_through, holes=a.holes())
+    entries = src.entries_from(a.dense_through, holes=a.holes())
     assert entries == [("late", 2)], entries
     a.apply_entries(entries)
     assert a.holes() == []
@@ -1922,3 +1922,37 @@ def test_status_snapshot_does_not_wipe_racing_announce(tmp_path):
         assert cl0._peer_shards[(n1.id, "i")] == {3, 4}
     finally:
         shutdown(servers)
+
+
+def test_translate_sender_holes_propagate_and_tombstone():
+    """A node that never saw a displacement locally (e.g. full-pulled
+    after the fork) must ADOPT the sender's known holes — else its
+    watermark sticks below the cluster-wide vacancy and every
+    incremental pull re-ships the whole tail. And once the PRIMARY
+    confirms a requested hole is vacant with its counter past it, the
+    puller stops re-requesting it forever."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    src = TranslateStore()  # the chain's store, carries the fork hole
+    src.open()
+    src.apply_entries([(f"k{i}", i) for i in (1, 2, 3)])
+    src.apply_entries([("k2", 9)])  # displaces (k2, 2) → hole at 2
+    src.apply_entries([(f"k{i}", i) for i in (4, 5, 6, 7, 8)])
+    assert src.holes() == [2] and src.dense_through == 9
+
+    fresh = TranslateStore()  # full-pulls; never saw the displacement
+    fresh.open()
+    entries, sender_holes, vacant = src.tail_for(0, None)
+    fresh.apply_entries(entries)
+    assert fresh.dense_through == 1  # stuck below the vacancy...
+    fresh.adopt_holes(sender_holes)
+    assert fresh.dense_through == 9  # ...until the hole is adopted
+    # incremental tails are now O(new), not O(whole keyspace)
+    assert src.entries_from(fresh.dense_through, holes=fresh.holes()) == []
+    # the primary confirms id 2 vacant (its counter is past it): the
+    # puller tombstones it and stops asking
+    _e, _sh, vac = src.tail_for(fresh.dense_through, fresh.holes())
+    assert vac == [2]
+    fresh.forget_holes(vac)
+    assert fresh.holes() == []
+    assert fresh.dense_through == 9  # watermark unchanged by the forget
