@@ -37,6 +37,7 @@ class TranslateStore:
         # explicitly, so a binding the surviving chain issues for a hole
         # id later still arrives (see entries_from(holes=...)).
         self._holes: set[int] = set()
+        self._hole_pull_cursor = 0
         self._file = None
 
     def open(self) -> None:
@@ -71,8 +72,7 @@ class TranslateStore:
         self._by_id[id_] = key
         self._next_id = max(self._next_id, id_ + 1)
         self._holes.discard(id_)  # a late binding fills the gap
-        while (nxt := self._dense_through + 1) in self._by_id or nxt in self._holes:
-            self._dense_through += 1
+        self._advance_watermark()
 
     @property
     def dense_through(self) -> int:
@@ -118,6 +118,14 @@ class TranslateStore:
             return [self._by_id.get(i) for i in ids]
 
     # ------------------------------------------------- replication support
+    def _advance_watermark(self) -> None:
+        """Advance dense_through across present ids AND recorded holes
+        (callers hold self._lock)."""
+        while (nxt := self._dense_through + 1) in self._by_id or (
+            nxt in self._holes
+        ):
+            self._dense_through += 1
+
     def adopt_holes(self, ids: list[int]) -> None:
         """Adopt a SENDER's known holes (fork vacancies) for ids this
         store has no binding for. Without this, a node that never saw
@@ -128,40 +136,39 @@ class TranslateStore:
             for i in ids:
                 if i not in self._by_id:
                     self._holes.add(i)
-            while (nxt := self._dense_through + 1) in self._by_id or (
-                nxt in self._holes
-            ):
-                self._dense_through += 1
+            self._advance_watermark()
 
-    def forget_holes(self, ids: list[int]) -> None:
-        """Drop holes the PRIMARY confirmed vacant (it lacks a binding
-        too and its counter is past them): no chain binding can ever
-        arrive for these, so re-requesting them on every pull is pure
-        overhead. The watermark stays where it is — the ids remain
-        tombstoned vacancies, just no longer worth asking about."""
+    def holes_for_pull(self, limit: int = 1024) -> list[int]:
+        """A bounded, ROTATING slice of the hole set to request on an
+        incremental pull. Permanent cluster-wide vacancies are never
+        dropped (a node with a stale view of who holds what could
+        otherwise tombstone an id the surviving chain actually binds —
+        permanent divergence); instead the per-pull overhead is capped
+        and every hole is retried within ceil(n/limit) passes."""
         with self._lock:
-            for i in ids:
-                self._holes.discard(i)
+            if not self._holes:
+                return []
+            ordered = sorted(self._holes)
+            if len(ordered) <= limit:
+                return ordered
+            start = self._hole_pull_cursor % len(ordered)
+            self._hole_pull_cursor = (start + limit) % len(ordered)
+            window = ordered[start : start + limit]
+            if len(window) < limit:  # wrap
+                window += ordered[: limit - len(window)]
+            return window
 
     def tail_for(
         self, offset: int, requested_holes: list[int] | None = None
-    ) -> tuple[list[tuple[str, int]], list[int], list[int]]:
-        """The full tailing answer: (entries, own_holes, vacant).
-        ``entries`` are bindings with id > offset plus any binding held
-        for a requested hole id; ``own_holes`` are this store's known
-        vacancies (for the puller to adopt); ``vacant`` are the
-        requested hole ids this store ALSO lacks AND its counter has
-        already passed — from the primary that is proof no chain binding
-        can ever arrive for them (ids allocate forward only)."""
+    ) -> tuple[list[tuple[str, int]], list[int]]:
+        """The full tailing answer: (entries, own_holes). ``entries``
+        are bindings with id > offset plus any binding held for a
+        requested hole id; ``own_holes`` are this store's known
+        vacancies, for the puller to adopt."""
         entries = self.entries_from(offset, holes=requested_holes)
         with self._lock:
             own = sorted(self._holes)
-            vacant = [
-                i
-                for i in (requested_holes or ())
-                if i not in self._by_id and i < self._next_id
-            ]
-        return entries, own, vacant
+        return entries, own
 
     def entries_from(
         self, offset: int, holes: list[int] | None = None

@@ -249,7 +249,7 @@ class InternalClient:
         otherwise exceed the server's request-line limit and fail the
         tail permanently. Extra chunks use an offset past any real id so
         only the requested holes come back."""
-        entries, _sh, _vac = self.translate_tail(uri, index, field, offset, holes)
+        entries, _sh = self.translate_tail(uri, index, field, offset, holes)
         return entries
 
     def translate_tail(
@@ -259,11 +259,9 @@ class InternalClient:
         field: str | None,
         offset: int,
         holes: list[int] | None = None,
-    ) -> tuple[list[tuple[str, int]], list[int], list[int]]:
-        """Full tailing answer: (entries, sender_holes, vacant) — the
-        sender's own known vacancies (for the puller to adopt) and the
-        requested hole ids the sender also lacks (tombstone candidates
-        when the sender is the primary)."""
+    ) -> tuple[list[tuple[str, int]], list[int]]:
+        """Full tailing answer: (entries, sender_holes) — the sender's
+        own known vacancies, for the puller to adopt."""
         no_tail = 1 << 62  # ids allocate densely from 1; never reached
 
         def fetch(off: int, hs: list[int]):
@@ -276,19 +274,17 @@ class InternalClient:
             return (
                 [(e["k"], e["id"]) for e in resp["entries"]],
                 resp.get("senderHoles", []),
-                resp.get("vacant", []),
             )
 
         chunk = 512
         holes = list(holes or ())
-        entries, sender_holes, vacant = fetch(offset, holes[:chunk])
+        entries, sender_holes = fetch(offset, holes[:chunk])
         for lo in range(chunk, len(holes), chunk):
             # hole ids are ≤ the caller's watermark ≤ no_tail, so the
             # sender's `i <= offset` guard admits every requested id
-            e2, _sh2, v2 = fetch(no_tail, holes[lo : lo + chunk])
+            e2, _sh2 = fetch(no_tail, holes[lo : lo + chunk])
             entries.extend(e2)
-            vacant.extend(v2)
-        return entries, sender_holes, vacant
+        return entries, sender_holes
 
     # --------------------------------------------------------- broadcast
     def remove_node(self, uri: str, node_id: str, node_uri: str | None = None) -> None:

@@ -1675,7 +1675,21 @@ class Cluster:
                 and now != i
             )
             if corrected:
-                self._push_translate_entries(index, field, corrected)
+                try:
+                    self._push_translate_entries(index, field, corrected)
+                except Exception as e:  # noqa: BLE001
+                    # best-effort within this ack (the allocation itself
+                    # replicated fine): remember the chain bindings for
+                    # the next allocation's re-push instead of failing a
+                    # complete allocation — AE tailing also heals them
+                    with self._unpushed_lock:
+                        self._unpushed_translate.setdefault(skey, {}).update(
+                            dict(corrected)
+                        )
+                    self.server.logger.log(
+                        f"translate corrective push deferred ({e}); "
+                        "entries queued for the next allocation's re-push"
+                    )
         return ids
 
     def _push_translate_entries(
@@ -1817,10 +1831,10 @@ class Cluster:
                     stores.append((f_name, f.row_keys))
             for f_name, store in stores:
                 try:
-                    entries, sender_holes, vacant = self.client.translate_tail(
+                    entries, sender_holes = self.client.translate_tail(
                         node.uri, idx_name, f_name,
                         0 if full else store.dense_through,
-                        holes=None if full else store.holes(),
+                        holes=None if full else store.holes_for_pull(),
                     )
                 except PeerError:
                     ok = False
@@ -1832,11 +1846,6 @@ class Cluster:
                 # re-ships the whole tail above the hole)
                 if sender_holes:
                     store.adopt_holes(sender_holes)
-                if vacant and node.id == self._translate_primary().id:
-                    # the PRIMARY also lacks these requested hole ids and
-                    # its counter is past them — no chain binding can
-                    # ever arrive; stop re-requesting them forever
-                    store.forget_holes(vacant)
                 if dropped:
                     self.server.logger.log(
                         f"translate {idx_name}/{f_name or '<columns>'}: "
@@ -2402,20 +2411,17 @@ class Cluster:
             # unknown index OR field (schema broadcast raced the pull):
             # empty answer, same as the index-missing case — a 500 here
             # fails the caller's fence for a transient race
-            handler._json({"entries": [], "senderHoles": [], "vacant": []})
+            handler._json({"entries": [], "senderHoles": []})
             return
         holes = [
             int(x) for x in p.get("holes", [""])[0].split(",") if x
         ]
-        entries, own_holes, vacant = store.tail_for(offset, holes)
+        entries, own_holes = store.tail_for(offset, holes)
         handler._json({
             "entries": [{"k": k, "id": i} for k, i in entries],
             # the sender's known vacancies: the puller adopts the ones it
             # lacks so its watermark can cross cluster-wide fork holes
             "senderHoles": own_holes,
-            # requested holes this store ALSO lacks — from the primary,
-            # proof the binding can never arrive (tombstone the request)
-            "vacant": vacant,
         })
 
     def _h_translate_create(self, handler) -> None:

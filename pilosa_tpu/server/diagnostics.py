@@ -93,11 +93,24 @@ class DiagnosticsCollector:
         interval = self.server.config.diagnostics_interval
         if interval <= 0:
             return
-        # first flush off the startup path: _backend() may initialize the
-        # JAX runtime, which must not block Server.open
-        self._first_flush = threading.Thread(target=self.flush, daemon=True)
+        # first flush off the startup path — and AFTER the mesh-attach
+        # verdict: _backend() initializes the JAX runtime, and doing
+        # that before the server's device probe has decided the platform
+        # would enter a possibly-wedged accelerator init holding jax's
+        # process-global init lock, hanging every later jax call (the
+        # attach thread's own CPU pin included)
+        def first():
+            self._gate_on_device_verdict()
+            self.flush()
+
+        self._first_flush = threading.Thread(target=first, daemon=True)
         self._first_flush.start()
         self._schedule(interval)
+
+    def _gate_on_device_verdict(self) -> None:
+        wait = getattr(self.server, "wait_mesh", None)
+        if wait is not None:
+            wait(None)
 
     def _schedule(self, interval: float) -> None:
         if self._closed:
@@ -105,6 +118,10 @@ class DiagnosticsCollector:
 
         def tick():
             try:
+                # same gate as the first flush: a periodic flush racing
+                # an undecided device probe would enter the wedged
+                # backend init and hold jax's init lock before the pin
+                self._gate_on_device_verdict()
                 self.flush()
             finally:
                 self._schedule(interval)

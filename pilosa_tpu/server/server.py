@@ -17,6 +17,9 @@ from pilosa_tpu.server.api import API
 from pilosa_tpu.server.http import HTTPServer
 from pilosa_tpu.utils.config import Config
 
+# process-wide device-backend probe verdict (backends are process-global)
+_DEVICE_PROBE_OK: bool | None = None
+
 
 class Server:
     def __init__(self, config: Config | None = None):
@@ -101,22 +104,23 @@ class Server:
                 self.config.num_processes or None,
                 self.config.process_id if self.config.process_id >= 0 else None,
             )
-        if self.config.mesh_enabled:
-            # attach OFF-THREAD: MeshContext.auto's jax.local_devices()
-            # initializes the accelerator backend, and on a tunneled
-            # device a wedged transport hangs that init indefinitely
-            # (observed 2026-07-31: Server.open stuck in
-            # make_c_api_client). Boot must not depend on the
-            # accelerator: ingest/admin/control-plane serve immediately
-            # on the host path; the mesh executor swaps in when (if) the
-            # backend comes up. attach_mesh rebinds whole objects, so
-            # in-flight queries see either the old or the new executor.
-            t = threading.Thread(
-                target=self._attach_mesh_when_ready, daemon=True,
-                name="mesh-attach",
-            )
-            t.start()
-            self._mesh_attach_thread = t
+        # Device bring-up OFF-THREAD, even with the mesh disabled (the
+        # probe/CPU-pin decision protects EVERY first jax use, not just
+        # the mesh attach): MeshContext.auto's jax.local_devices()
+        # initializes the accelerator backend, and on a tunneled device
+        # a wedged transport hangs that init indefinitely (observed
+        # 2026-07-31: Server.open stuck in make_c_api_client). Boot must
+        # not depend on the accelerator: ingest/admin/control-plane
+        # serve immediately on the host path; the mesh executor swaps in
+        # when (if) the backend comes up. attach_mesh rebinds whole
+        # objects, so in-flight queries see either the old or the new
+        # executor.
+        t = threading.Thread(
+            target=self._attach_mesh_when_ready, daemon=True,
+            name="mesh-attach",
+        )
+        t.start()
+        self._mesh_attach_thread = t
         if self.cluster is not None:
             self.cluster.join()
         self._schedule_anti_entropy()
@@ -126,8 +130,63 @@ class Server:
         self.api.diagnostics = self.diagnostics
         self.diagnostics.open()
 
+    @staticmethod
+    def _probe_device_backend(timeout_s: float) -> bool:
+        """Prove the backend this process will use initializes, in a
+        FRESH subprocess (a wedged device transport hangs init forever,
+        and a hang inside THIS process would poison every later jax
+        call — backend init is process-global and uninterruptible). The
+        child mirrors the parent's config-level platform pin: an env var
+        alone can be swallowed by a site-installed plugin hook. The
+        verdict is cached process-wide — backends are process-global, so
+        one probe answers for every Server this process opens."""
+        global _DEVICE_PROBE_OK
+        if _DEVICE_PROBE_OK is not None:
+            return _DEVICE_PROBE_OK
+        import subprocess
+        import sys
+
+        import jax
+
+        pin = jax.config.jax_platforms
+        body = (
+            f"import jax; jax.config.update('jax_platforms', {pin!r}); "
+            "jax.devices()"
+            if pin
+            else "import jax; jax.devices()"
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", body],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                timeout=timeout_s,
+            )
+            _DEVICE_PROBE_OK = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            _DEVICE_PROBE_OK = False
+        return _DEVICE_PROBE_OK
+
     def _attach_mesh_when_ready(self) -> None:
         try:
+            timeout_s = self.config.device_init_timeout
+            if timeout_s > 0 and not self._probe_device_backend(timeout_s):
+                # the accelerator cannot be trusted to init: pin THIS
+                # process to the CPU backend before any jax call, or the
+                # first query would hang indefinitely inside backend
+                # init. Loud — this trades device speed for liveness
+                # until restart.
+                import jax
+
+                jax.config.update("jax_platforms", "cpu")
+                self.logger.log(
+                    "accelerator backend failed to initialize within "
+                    f"{timeout_s:.0f}s — pinning this process to the CPU "
+                    "backend (queries serve on host; restart to retry "
+                    "the device)"
+                )
+            if not self.config.mesh_enabled:
+                return  # probe/pin decided; nothing to attach
             ctx = self._make_mesh_context()
         except Exception as e:  # noqa: BLE001 — backend init is best-effort
             self.logger.log(f"mesh attach failed (serving host path): {e}")

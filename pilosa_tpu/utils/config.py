@@ -32,6 +32,12 @@ class Config:
     # device mesh (serving-path SPMD over all local devices)
     mesh_enabled: bool = True
     mesh_words_axis: int = 1  # >1 splits the packed word dim across devices
+    # seconds to wait for the accelerator backend to prove healthy (a
+    # fresh-subprocess probe) before pinning this process to the CPU
+    # backend: a wedged device transport otherwise hangs the FIRST query
+    # indefinitely inside backend init. 0 disables the probe (trust the
+    # accelerator to come up).
+    device_init_timeout: float = 300.0
     # multi-host process group (jax.distributed; reference analogue:
     # gossip seeds — here membership is static). Setting
     # coordinator_address makes Server.open() join the group before any
@@ -138,6 +144,7 @@ def config_template() -> str:
         "long-query-time = 0.0\n"
         "mesh-enabled = true\n"
         "mesh-words-axis = 1\n"
+        "device-init-timeout = 300.0\n"
         'metric-service = "prometheus"\n'
         'tls-certificate = ""\n'
         'tls-key = ""\n'

@@ -146,3 +146,37 @@ def test_mesh_disabled_by_config(tmp_path):
         assert s.api.mesh_ctx is None
     finally:
         s.close()
+
+
+def test_device_probe_failure_pins_cpu_and_serves(tmp_path, monkeypatch):
+    """When the accelerator backend cannot prove it initializes, the
+    server pins the process to the CPU backend and still serves queries
+    (a wedged device transport used to hang the FIRST query forever
+    inside backend init)."""
+    import pilosa_tpu.server.server as srvmod
+
+    monkeypatch.setattr(
+        srvmod.Server, "_probe_device_backend", staticmethod(lambda t: False)
+    )
+    s = Server(
+        Config(
+            bind="127.0.0.1:0",
+            data_dir=str(tmp_path / "d"),
+            anti_entropy_interval=0,
+            device_init_timeout=1.0,
+        )
+    )
+    s.open()
+    try:
+        assert s.wait_mesh(60)
+        import jax
+
+        assert jax.config.jax_platforms == "cpu"
+        call(s, "POST", "/index/p", None)
+        call(s, "POST", "/index/p/field/f", None)
+        call(s, "POST", "/index/p/query", b"Set(3, f=1)")
+        r = call(s, "POST", "/index/p/query", b"Count(Row(f=1))")
+        assert r["results"] == [1]
+    finally:
+        s.close()
+        jax.config.update("jax_platforms", "cpu")  # leave suite pinned
