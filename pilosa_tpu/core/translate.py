@@ -158,16 +158,24 @@ class TranslateStore:
                 window += ordered[: limit - len(window)]
             return window
 
+    SENDER_HOLES_MAX = 4096
+
     def tail_for(
         self, offset: int, requested_holes: list[int] | None = None
     ) -> tuple[list[tuple[str, int]], list[int]]:
         """The full tailing answer: (entries, own_holes). ``entries``
         are bindings with id > offset plus any binding held for a
         requested hole id; ``own_holes`` are this store's known
-        vacancies, for the puller to adopt."""
+        vacancies ABOVE the offset, for the puller to adopt — holes at
+        or below the puller's cursor are either bound on the puller or
+        already its own holes, so shipping them is pure payload. Capped;
+        an over-cap remainder reaches the puller on later pulls (its
+        offset advances past the holes it already adopted)."""
         entries = self.entries_from(offset, holes=requested_holes)
         with self._lock:
-            own = sorted(self._holes)
+            own = sorted(i for i in self._holes if i > offset)[
+                : self.SENDER_HOLES_MAX
+            ]
         return entries, own
 
     def entries_from(

@@ -163,7 +163,11 @@ class Server:
                 timeout=timeout_s,
             )
             _DEVICE_PROBE_OK = proc.returncode == 0
-        except subprocess.TimeoutExpired:
+        except Exception:  # noqa: BLE001 — timeout, fork failure, ...
+            # ANY probe failure means the device is unproven: report
+            # False so the caller pins CPU. Letting an OSError escape
+            # here would skip the pin and recreate the indefinite
+            # first-jax-call hang this probe exists to prevent.
             _DEVICE_PROBE_OK = False
         return _DEVICE_PROBE_OK
 

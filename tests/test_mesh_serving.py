@@ -164,6 +164,7 @@ def test_device_probe_failure_pins_cpu_and_serves(tmp_path, monkeypatch):
             data_dir=str(tmp_path / "d"),
             anti_entropy_interval=0,
             device_init_timeout=1.0,
+            log_path=str(tmp_path / "server.log"),
         )
     )
     s.open()
@@ -171,7 +172,12 @@ def test_device_probe_failure_pins_cpu_and_serves(tmp_path, monkeypatch):
         assert s.wait_mesh(60)
         import jax
 
+        # the conftest already pins cpu process-wide, so asserting the
+        # config value alone would be vacuous — assert the server's own
+        # pin decision via its log line
         assert jax.config.jax_platforms == "cpu"
+        log = (tmp_path / "server.log").read_text()
+        assert "pinning this process to the CPU backend" in log, log
         call(s, "POST", "/index/p", None)
         call(s, "POST", "/index/p/field/f", None)
         call(s, "POST", "/index/p/query", b"Set(3, f=1)")
