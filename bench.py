@@ -180,12 +180,26 @@ def _child_main(n_shards: int) -> None:
     tpu_seconds = pipelined(tpu_iters)
     _stage({"stage": "executor_qps", "qps": round(1 / tpu_seconds, 2)})
 
-    # sync end-to-end latency: parse → execute → host scalar, p50
+    # sync end-to-end latency: parse → execute → host scalar. Latencies
+    # accumulate into the serving stack's own log-bucketed Histogram so
+    # the artifact records the tail (p95/p99), not just the median —
+    # under fan-out skew the tail IS the product metric.
+    from pilosa_tpu.utils.stats import Histogram
+
+    def hist_ms(h: Histogram) -> dict:
+        return {
+            "p50_ms": round(h.percentile(0.50) * 1e3, 2),
+            "p95_ms": round(h.percentile(0.95) * 1e3, 2),
+            "p99_ms": round(h.percentile(0.99) * 1e3, 2),
+        }
+
+    e2e_hist = Histogram()
     lats = []
     for _ in range(min(tpu_iters, 30)):
         t0 = time.perf_counter()
         e.execute("bench", pql, shards=shards)
         lats.append(time.perf_counter() - t0)
+        e2e_hist.observe(lats[-1])
     e2e_p50_ms = sorted(lats)[len(lats) // 2] * 1e3
 
     # transport floor: a trivial sync dispatch+readback. On a tunneled
@@ -221,11 +235,13 @@ def _child_main(n_shards: int) -> None:
     topn_res = e.execute("bench", "TopN(f, n=5)", shards=shards)[0]
     got_top = [(p["count"], p["id"]) for p in topn_res]
     assert got_top == want_top, f"TopN {got_top} != {want_top}"
+    topn_hist = Histogram()
     lats = []
     for _ in range(min(tpu_iters, 30)):
         t0 = time.perf_counter()
         e.execute("bench", "TopN(f, n=5)", shards=shards)
         lats.append(time.perf_counter() - t0)
+        topn_hist.observe(lats[-1])
     topn_p50_ms = sorted(lats)[len(lats) // 2] * 1e3
     _stage({"stage": "topn", "p50_ms": round(topn_p50_ms, 2)})
 
@@ -243,6 +259,10 @@ def _child_main(n_shards: int) -> None:
                 "path": "executor_pipelined",
                 "e2e_p50_ms": round(e2e_p50_ms, 2),
                 "topn_p50_ms": round(topn_p50_ms, 2),
+                # log-bucketed histogram tails (pilosa_tpu.utils.stats
+                # Histogram — the same distribution /metrics exposes)
+                "e2e_hist": hist_ms(e2e_hist),
+                "topn_hist": hist_ms(topn_hist),
                 "transport_rtt_ms": round(rtt_ms, 1),
                 # tunnel-independent server time: on a tunneled chip the
                 # sync RTT floor (~70 ms in r3) swamps every p50 — the

@@ -31,15 +31,30 @@ def _apply_jax_platform_env() -> None:
         current = jax.config.jax_platforms
         allowed = {p for p in current.split(",") if p} if current else None
         wanted = {p for p in plat.split(",") if p}
-        if allowed is None or wanted <= allowed:
+        if allowed is None or wanted <= allowed or wanted == {"cpu"}:
             # the explicit update is what actually defeats a plugin hook
             # that swallows the env var (a site plugin may have set e.g.
             # "accel,cpu" — narrowing to the env's "cpu" is what the
-            # operator asked for). But never ADD a platform an
+            # operator asked for). Narrowing to the CPU backend alone is
+            # ALWAYS honored, even when the in-process pin names only an
+            # accelerator: a CPU init cannot hang, and dropping the
+            # operator's explicit cpu pin is exactly how a wedged
+            # transport gets re-entered. But never ADD a platform an
             # in-process caller excluded: tests/embedders that pinned
             # "cpu" must not be flipped back to the env's accelerator —
             # the next backend init would hang on a wedged transport.
             jax.config.update("jax_platforms", plat)
+        else:
+            # loud, not silent: the operator set the env var and nothing
+            # happened — say so instead of leaving an inert override to
+            # be discovered as a hang later
+            print(
+                f"JAX_PLATFORMS={plat!r} ignored: this process already "
+                f"pinned jax_platforms={current!r} and the override would "
+                "widen it (only narrowing, or an explicit 'cpu', is honored)",
+                file=sys.stderr,
+                flush=True,
+            )
 
 
 def _base_uri(host: str) -> str:

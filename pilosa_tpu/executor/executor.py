@@ -22,6 +22,7 @@ Redesigned for TPU:
 from __future__ import annotations
 
 import os
+import time
 from datetime import datetime
 from typing import Any
 
@@ -50,6 +51,8 @@ from pilosa_tpu.executor.row import RowResult
 from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 def apply_options(idx: "Index", call: "Call", res: Any) -> Any:
     """Apply an Options() wrapper's result-shaping args (reference:
@@ -199,8 +202,9 @@ class Executor:
             return int(env)
         return max(256 << 20, _stack_budget() // 8)
 
-    def __init__(self, holder: Holder, mesh_ctx=None):
+    def __init__(self, holder: Holder, mesh_ctx=None, stats=None):
         self.holder = holder
+        self.stats = stats  # optional StatsClient for per-call histograms
         self.compiler = QueryCompiler(mesh_ctx)
 
     # ------------------------------------------------------------ entry
@@ -218,9 +222,28 @@ class Executor:
         # resolve together after every call has dispatched. Dispatch
         # order is program order, so an aggregate preceding a write still
         # reads pre-write state — exactly the sequential semantics.
-        results = [self._execute_call(idx, c, shards, lazy=True) for c in calls]
+        # Per-call dispatch is spanned + histogram-timed (the readback
+        # wave is timed separately below: pipelining means a call's
+        # device time is not attributable to its own dispatch).
+        prof = tracing.current_profile()
+        prof_shards: list[int] | None = None
+        results = []
+        for c in calls:
+            t0 = time.perf_counter()
+            with GLOBAL_TRACER.span(f"executor.{c.name}", index=index_name):
+                results.append(self._execute_call(idx, c, shards, lazy=True))
+            elapsed = time.perf_counter() - t0
+            if self.stats is not None:
+                self.stats.timing(
+                    "executor_call_seconds", elapsed, tags={"call": c.name}
+                )
+            if prof is not None:
+                if prof_shards is None:
+                    prof_shards = self._shards(idx, shards)
+                prof.add_call(c.name, elapsed, prof_shards)
         pending = [r for r in results if isinstance(r, _Pending)]
         if pending:
+            t0 = time.perf_counter()
             flat = [
                 jnp.ravel(a).astype(jnp.int64) for p in pending for a in p.arrays
             ]
@@ -239,6 +262,13 @@ class Executor:
                     args.append(host[i].reshape(np.shape(a)))
                     i += 1
                 p.value = p.finish(args)
+            elapsed = time.perf_counter() - t0
+            if self.stats is not None:
+                self.stats.timing("executor_readback_seconds", elapsed)
+            if prof is not None:
+                # the one device→host sync the whole request pays; on a
+                # tunneled accelerator this line IS the latency story
+                prof.add_call("_readback", elapsed, None)
         return [r.value if isinstance(r, _Pending) else r for r in results]
 
     def _shards(self, idx: Index, shards: list[int] | None) -> list[int]:

@@ -13,6 +13,9 @@ import json
 import urllib.error
 import urllib.request
 
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.tracing import GLOBAL_TRACER
+
 
 class PeerError(RuntimeError):
     def __init__(self, uri: str, detail: str):
@@ -53,13 +56,24 @@ class InternalClient:
         req = urllib.request.Request(uri + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        # trace propagation (Inject): the receiving node's spans join the
+        # caller's trace and parent onto the span active on this thread
+        ctx = GLOBAL_TRACER.current_context()
+        if ctx is not None:
+            req.add_header(tracing.TRACE_HEADER, ctx[0])
+            if ctx[1]:
+                req.add_header(tracing.PARENT_HEADER, ctx[1])
         try:
             with urllib.request.urlopen(
                 req,
                 timeout=self.timeout if timeout is None else timeout,
                 context=self._context(uri),
             ) as resp:
-                return resp.read()
+                data = resp.read()
+                prof = tracing.current_profile()
+                if prof is not None:
+                    prof.note_rpc_bytes(len(data))
+                return data
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
             raise PeerError(uri, f"HTTP {e.code}: {detail}") from e
@@ -104,6 +118,12 @@ class InternalClient:
             control, blobs = frame.decode_frame(raw)
             return [decode_result(d, blobs) for d in control["results"]]
         return [decode_result(d) for d in json.loads(raw)["results"]]
+
+    def fetch_trace(self, uri: str, trace_id: str) -> list[dict]:
+        """One trace's spans buffered on a peer (GET /internal/trace) —
+        the coordinator stitches them under its own spans for export."""
+        resp = self._json("GET", uri, f"/internal/trace?trace_id={trace_id}")
+        return resp.get("spans", [])
 
     def node_shards(self, uri: str, index: str) -> list[int]:
         resp = self._json("GET", uri, f"/internal/shards?index={index}")

@@ -51,6 +51,8 @@ from pilosa_tpu.encoding import frame
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.roaring import serialize
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 HEARTBEAT_INTERVAL = 2.0
 
@@ -146,6 +148,7 @@ class Cluster:
         router (which rejects with 503 while STARTING), never the local
         default router; peers probing /internal/* must not see 404."""
         self._mount_internal_routes()
+        self.server.http.trace_fetch = self._fetch_cluster_trace
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
         self.server.http.translate_router = self._route_translate_keys
@@ -437,6 +440,14 @@ class Cluster:
             self.removed = True
             self.state = STATE_REMOVED
             return
+        new_uris = {d["uri"] for d in node_dicts}
+        # members the adopted list no longer carries: a removal this node
+        # missed (or whose broadcast is still in flight). Keep their Node
+        # objects — a draining victim still serves /internal/* reads, and
+        # for replica_n=1 it is the only holder of its former shards.
+        dropped = [
+            x for x in self.nodes if x.id != self.me.id and x.uri not in new_uris
+        ]
         by_uri = {x.uri: x for x in self.nodes}
         new_nodes: list[Node] = []
         grew = False
@@ -483,17 +494,22 @@ class Cluster:
                     )
                 )
         self.topology.nodes = sorted(new_nodes, key=lambda x: x.id)
-        if grew:
-            # placement reshuffles on growth (partition % n): pull any
-            # shards this node NOW owns but doesn't hold; fragments we no
-            # longer own hand off at the next anti-entropy pass. OFF the
-            # heartbeat thread — a synchronous pull would block liveness
-            # ticks for the whole transfer; reads stay exact through the
-            # window via holder-preferring routing.
+        if grew or dropped:
+            # placement reshuffles on growth AND shrink (partition % n):
+            # pull any shards this node NOW owns but doesn't hold;
+            # fragments we no longer own hand off at the next
+            # anti-entropy pass. A shrink pulls from the dropped nodes
+            # too — a removal broadcast this node missed (the heartbeat
+            # adopting a survivor's post-removal epoch mid-drain) would
+            # otherwise strand the victim's sole-copy shards until
+            # anti-entropy. OFF the heartbeat thread — a synchronous
+            # pull would block liveness ticks for the whole transfer;
+            # reads stay exact through the window via holder-preferring
+            # routing.
             def rebalance():
                 prev_state, self.state = self.state, STATE_RESIZING
                 try:
-                    self._pull_owned_fragments(self._peers())
+                    self._pull_owned_fragments(dropped + self._peers())
                 finally:
                     if self.state == STATE_RESIZING:
                         self.state = prev_state
@@ -651,6 +667,35 @@ class Cluster:
         unknown."""
         node = self._resolve_node(ident, uri)
         if node is None:
+            if uri:
+                # Already absent from our topology: an epoch adoption
+                # raced the explicit removal broadcast (the heartbeat
+                # adopted a survivor's post-removal list mid-drain). The
+                # adoption path's pull runs ASYNC — but this broadcast
+                # leg is the victim's synchronous drain barrier, so run
+                # the pull here anyway: the victim may be the only
+                # holder of shards this node now owns, and the caller
+                # (the decommissioned node, an admin script) relies on
+                # the data having moved when this returns. prev_state is
+                # RESTORED, never forced to NORMAL — a STARTING node
+                # must keep rejecting client traffic after the drain.
+                # Probe the uri first: it distinguishes a draining victim
+                # (still serving /internal/*) from a typo'd identifier —
+                # a never-member garbage uri must report failure, not
+                # "success" after a pointless cluster-wide sweep.
+                try:
+                    self.client.status(uri, timeout=5.0)
+                except PeerError:
+                    return False
+                prev_state, self.state = self.state, STATE_RESIZING
+                try:
+                    self._pull_owned_fragments(
+                        [Node(id=ident, uri=uri)] + self._peers()
+                    )
+                finally:
+                    if self.state == STATE_RESIZING:
+                        self.state = prev_state
+                return True
             return False
         if node.id == self.me.id:
             # self-removal (admin POSTed remove-node to the node being
@@ -1001,26 +1046,67 @@ class Cluster:
         by_node: dict[str, list[int]],
         node_by_id: dict[str, "Node"],
     ) -> list[Any]:
-        """Scatter one call to its shard owners, gather decoded partials."""
+        """Scatter one call to its shard owners, gather decoded partials.
+        Every leg records fan-out latency (histogram + span + profile
+        shard-group entry) so tail latency is attributable to the node —
+        and therefore the shards — that caused it."""
         partials: list[Any] = []
+        prof = tracing.current_profile()
+        stats = self.server.stats
         for node_id, node_shards in by_node.items():
+            t0 = time.perf_counter()
             if node_id == self.me.id:
-                partials.extend(
-                    self.server.api.executor.execute(index, [call], shards=node_shards)
-                )
-            else:
+                with GLOBAL_TRACER.span(
+                    "cluster.local", node=node_id, shards=len(node_shards)
+                ):
+                    partials.extend(
+                        self.server.api.executor.execute(
+                            index, [call], shards=node_shards
+                        )
+                    )
+                if prof is not None:
+                    prof.add_fanout(
+                        call.name,
+                        node_id,
+                        node_shards,
+                        time.perf_counter() - t0,
+                        0,
+                    )
+                continue
+            with GLOBAL_TRACER.span(
+                "cluster.fanout", node=node_id, shards=len(node_shards)
+            ):
                 try:
                     remote = self.client.query_node(
                         node_by_id[node_id].uri, index, call.to_pql(), node_shards
                     )
                 except PeerError as e:
-                    # heartbeat state was stale: mark dead NOW so the next
-                    # read reroutes to a replica, and fail this one loudly
-                    node_by_id[node_id].alive = False
+                    # a probe-gate 503 means the peer is ALIVE and serving
+                    # (its heartbeats succeed) but its device verdict is
+                    # pending — marking it dead would route reads around a
+                    # live sole holder on every client retry for the whole
+                    # probe window. Any other failure: heartbeat state was
+                    # stale — mark dead NOW so the next read reroutes to a
+                    # replica, and fail this one loudly either way.
+                    if "device probe in progress" not in str(e):
+                        node_by_id[node_id].alive = False
                     raise ShardUnavailableError(
                         f"shard owner {node_id} failed mid-query: {e}"
                     ) from e
-                partials.extend(remote)  # query_node returns decoded results
+            elapsed = time.perf_counter() - t0
+            if stats is not None:
+                stats.timing(
+                    "fanout_rpc_seconds", elapsed, tags={"node": node_id}
+                )
+            if prof is not None:
+                prof.add_fanout(
+                    call.name,
+                    node_id,
+                    node_shards,
+                    elapsed,
+                    prof.take_rpc_bytes(),
+                )
+            partials.extend(remote)  # query_node returns decoded results
         return partials
 
     def _pin_groupby_rows(self, index: str, call: Call, shards) -> Call:
@@ -2106,6 +2192,7 @@ class Cluster:
                 "GET",
                 re.compile(r"^/internal/attrs/block/data$"),
             ): self._h_attr_block_data,
+            ("GET", re.compile(r"^/internal/trace$")): self._h_trace,
             ("GET", re.compile(r"^/internal/translate/data$")): self._h_translate_data,
             (
                 "POST",
@@ -2141,7 +2228,20 @@ class Cluster:
 
     # each handler receives the live request Handler object
     def _h_query(self, handler) -> None:
+        # body FIRST, gate second: the 503 must not leave unread body
+        # bytes on a keep-alive connection (the next request would parse
+        # from the stale body). Same device-probe gate as the client-
+        # facing query route: a coordinator's fan-out must not be the
+        # first JAX use on a node whose backend probe is still running.
+        # wait=False — the coordinator's RPC timeout (30s) is shorter
+        # than the gate wait, so blocking here would turn the probe
+        # window into a client-visible RPC timeout; failing fast maps to
+        # ShardUnavailableError (503 retry) at the coordinator instead.
         body = handler._json_body()
+        if not self.server._query_gate(wait=False):
+            raise ShardUnavailableError(
+                "device probe in progress on this node; retry"
+            )
         results = self.server.api.executor.execute(
             body["index"], body["query"], shards=body.get("shards")
         )
@@ -2152,6 +2252,26 @@ class Cluster:
         blobs: list[bytes] = []
         control = {"results": [encode_result(r, blobs) for r in results]}
         handler._bytes(frame.encode_frame(control, blobs), frame.CONTENT_TYPE)
+
+    def _h_trace(self, handler) -> None:
+        """One trace's locally buffered spans (the stitch half of
+        cross-node tracing: the coordinator pulls these from every peer
+        and merges them under its own HTTP span for chrome export)."""
+        trace_id = handler.query_params.get("trace_id", [""])[0]
+        if not trace_id:
+            raise ValueError("trace_id= required")
+        handler._json({"spans": GLOBAL_TRACER.spans_for_trace(trace_id)})
+
+    def _fetch_cluster_trace(self, trace_id: str) -> dict[str, list[dict]]:
+        """node id → span dicts for one trace, local buffer + every
+        reachable peer (unreachable peers just drop out of the view)."""
+        by_node = {self.me.id: GLOBAL_TRACER.spans_for_trace(trace_id)}
+        for n in self._peers():
+            try:
+                by_node[n.id] = self.client.fetch_trace(n.uri, trace_id)
+            except PeerError:
+                continue
+        return by_node
 
     def _h_shards_announce(self, handler) -> None:
         self._apply_shard_entries(handler._json_body())
@@ -2296,6 +2416,10 @@ class Cluster:
         return control
 
     def _h_import_bits(self, handler, index: str, field: str) -> None:
+        # deliberately NOT behind the device-probe gate: the import apply
+        # path is numpy/roaring only (JAX is first touched at query
+        # compile), so there is no wedged-backend-init hazard here — and
+        # gating would refuse replica writes for the whole probe window
         applied_by = self._apply_or_reforward_import(
             index, field, self._import_body(handler), values=False
         )

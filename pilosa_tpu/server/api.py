@@ -101,15 +101,15 @@ class API:
 
             mesh_ctx = MeshContext.auto()
         self.mesh_ctx = mesh_ctx
-        self.executor = Executor(holder, mesh_ctx=mesh_ctx)
         self.stats = stats
+        self.executor = Executor(holder, mesh_ctx=mesh_ctx, stats=stats)
         self.diagnostics = None  # set by Server.open
 
     def attach_mesh(self, mesh_ctx) -> None:
         """Late mesh attachment (Server.open does this after the HTTP
         listener is up so backend init never blocks the bind)."""
         self.mesh_ctx = mesh_ctx
-        self.executor = Executor(self.holder, mesh_ctx=mesh_ctx)
+        self.executor = Executor(self.holder, mesh_ctx=mesh_ctx, stats=self.stats)
 
     # ------------------------------------------------------------- schema
     def create_index(self, name: str, options: dict | None = None) -> Index:

@@ -35,6 +35,7 @@ from pilosa_tpu.parallel.topology import ShardUnavailableError
 from pilosa_tpu.server.api import RequestTooLargeError
 from pilosa_tpu.pql import PQLError
 from pilosa_tpu.utils import GLOBAL_TRACER, StatsClient
+from pilosa_tpu.utils import tracing
 
 _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("POST", re.compile(r"^/index/([^/]+)/query$"), "query"),
@@ -102,20 +103,28 @@ class Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         self.query_params = parse_qs(parsed.query)
         self.route_name = ""
-        for m, pattern, name in _ROUTES:
-            if m != method:
-                continue
-            match = pattern.match(parsed.path)
-            if match:
-                self.route_name = name
-                self.stats.count("http_requests", tags={"route": name})
-                with GLOBAL_TRACER.span(f"http.{name}"):
-                    self._guarded(getattr(self, "h_" + name), *match.groups())
-                return
-        # extra (/internal/*) routes get the same error mapping
-        handled = self._guarded(
-            self.server.handle_extra, self, method, parsed.path
-        )
+        # propagated trace context (coordinator → data plane): a remote
+        # node's spans join the coordinator's trace and parent onto its
+        # fan-out span instead of starting a disconnected trace
+        trace_id = self.headers.get(tracing.TRACE_HEADER)
+        parent_span = self.headers.get(tracing.PARENT_HEADER)
+        with GLOBAL_TRACER.activate(trace_id, parent_span):
+            for m, pattern, name in _ROUTES:
+                if m != method:
+                    continue
+                match = pattern.match(parsed.path)
+                if match:
+                    self.route_name = name
+                    self.stats.count("http_requests", tags={"route": name})
+                    with GLOBAL_TRACER.span(f"http.{name}"):
+                        self._guarded(getattr(self, "h_" + name), *match.groups())
+                    return
+            # extra (/internal/*) routes get the same error mapping, and a
+            # span so remote data-plane work appears in the stitched trace
+            with GLOBAL_TRACER.span("http.internal", path=parsed.path):
+                handled = self._guarded(
+                    self.server.handle_extra, self, method, parsed.path
+                )
         if handled is False:
             self._json({"error": "not found"}, code=404)
 
@@ -178,11 +187,13 @@ class Handler(BaseHTTPRequestHandler):
         except json.JSONDecodeError as e:
             raise ValueError(f"bad JSON body: {e}") from e
 
-    def _json(self, obj, code: int = 200) -> None:
+    def _json(self, obj, code: int = 200, extra_headers: dict | None = None) -> None:
         data = json.dumps(obj).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
@@ -222,17 +233,53 @@ class Handler(BaseHTTPRequestHandler):
             encoding.AVAILABLE and encoding.CONTENT_TYPE in accept
         )
 
-    def _proto(self, data: bytes, code: int = 200) -> None:
+    def _proto(self, data: bytes, code: int = 200, extra_headers: dict | None = None) -> None:
         self.send_response(code)
         self.send_header("Content-Type", encoding.CONTENT_TYPE)
         self.send_header("Content-Length", str(len(data)))
+        for k, v in (extra_headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(data)
 
     # -------------------------------------------------------------- routes
+    def _gate(self) -> bool:
+        """Device-probe gate for routes whose work reaches JAX: during
+        the probe window a query must not initialize the (possibly
+        wedged) accelerator backend in-process — that hang is
+        uninterruptible and holds JAX's process-global init lock, so the
+        post-probe CPU pin could never recover (ADVICE r5 medium). The
+        server-side gate waits a bounded slice for the verdict; if it is
+        still pending, serve 503 + Retry-After instead of dispatching."""
+        if self.server.gate():
+            return True
+        self._body()  # drain: an unread body would corrupt keep-alive framing
+        # same wire-format negotiation as _error(), plus Retry-After — a
+        # protobuf client must get a decodable QueryResponse/ImportResponse
+        # error envelope, not a JSON body it can't parse
+        msg = "device probe in progress; retry"
+        headers = {"Retry-After": "2"}
+        if self._wants_proto() and self.route_name.startswith("import"):
+            self._proto(
+                encoding.protoser.import_response_to_bytes(msg),
+                code=503,
+                extra_headers=headers,
+            )
+        elif self._wants_proto() and self.route_name == "query":
+            self._proto(
+                encoding.protoser.response_to_bytes({"results": [], "error": msg}),
+                code=503,
+                extra_headers=headers,
+            )
+        else:
+            self._json({"error": msg}, code=503, extra_headers=headers)
+        return False
+
     def h_query(self, index: str) -> None:
         import time
 
+        if not self._gate():
+            return
         body = self._body()
         proto = self._wants_proto()
         shards = self._shards_param()
@@ -241,18 +288,43 @@ class Handler(BaseHTTPRequestHandler):
             shards = shards or req_shards
         else:
             pql = body.decode()
+        want_profile = self.query_params.get("profile", [""])[0].lower() in (
+            "true",
+            "1",
+        )
         t0 = time.perf_counter()
-        with self.stats.timer("query_seconds", tags={"index": index}):
-            resp = self.server.query_router(index, pql, shards)
+        # the profile collector is always installed (a handful of dict
+        # appends per query) so the long-query log can name the slow
+        # shard group even when the client didn't ask for a profile
+        with tracing.profile_query() as prof:
+            with self.stats.timer("query_seconds", tags={"index": index}):
+                with GLOBAL_TRACER.span("pql.query", index=index) as sp:
+                    prof.trace_id = sp.trace_id
+                    resp = self.server.query_router(index, pql, shards)
         elapsed = time.perf_counter() - t0
+        prof.total_seconds = elapsed
         slow = self.server.long_query_time
         if slow > 0 and elapsed >= slow:
+            worst = prof.slowest()
+            where = ""
+            if worst is not None:
+                shard_list = worst.get("shards")
+                where = (
+                    f" slowest={worst['call']}"
+                    + (f" node={worst['node']}" if "node" in worst else "")
+                    + (f" shards={shard_list}" if shard_list else "")
+                    + f" ({worst['seconds']:.3f}s)"
+                )
             self.server.log(
-                f"long query ({elapsed:.3f}s) index={index}: {pql[:200]}"
+                f"long query ({elapsed:.3f}s) index={index}"
+                f" trace={prof.trace_id}{where}: {pql[:200]}"
             )
         if proto:
             self._proto(encoding.protoser.response_to_bytes(resp))
         else:
+            if want_profile:
+                resp = dict(resp)
+                resp["profile"] = prof.to_json()
             self._json(resp)
 
     def h_create_index(self, index: str) -> None:
@@ -299,16 +371,22 @@ class Handler(BaseHTTPRequestHandler):
             self._json({"success": True})
 
     def h_import_bits(self, index: str, field: str) -> None:
+        if not self._gate():
+            return
         payload = self._import_payload(values=False)
         self.server.import_router(index, field, payload, values=False)
         self._import_ok()
 
     def h_import_values(self, index: str, field: str) -> None:
+        if not self._gate():
+            return
         payload = self._import_payload(values=True)
         self.server.import_router(index, field, payload, values=True)
         self._import_ok()
 
     def h_import_roaring(self, index: str, field: str, shard: str) -> None:
+        if not self._gate():
+            return
         param_view = self.query_params.get("view", [""])[0]
         if self._proto_body():
             data, view = encoding.protoser.import_roaring_request_from_bytes(
@@ -378,8 +456,31 @@ class Handler(BaseHTTPRequestHandler):
         self._json(out)
 
     def h_debug_traces(self) -> None:
-        if self.query_params.get("format", [""])[0] == "chrome":
-            self._json(GLOBAL_TRACER.chrome_trace())
+        """Recent spans, or one trace by id. ``?trace_id=`` filters to a
+        single trace; with ``format=chrome`` the cluster layer (when
+        attached) fetches that trace's remote spans from every peer via
+        GET /internal/trace and stitches one Perfetto-loadable file —
+        the coordinating HTTP span with each node's spans nested inside
+        on its own process track."""
+        trace_id = self.query_params.get("trace_id", [""])[0]
+        chrome = self.query_params.get("format", [""])[0] == "chrome"
+        if chrome:
+            if trace_id:
+                fetch = self.server.trace_fetch
+                by_node = (
+                    fetch(trace_id)
+                    if fetch is not None
+                    else {
+                        self.server.node_id: GLOBAL_TRACER.spans_for_trace(
+                            trace_id
+                        )
+                    }
+                )
+                self._json(tracing.chrome_trace_stitched(by_node))
+            else:
+                self._json(GLOBAL_TRACER.chrome_trace())
+        elif trace_id:
+            self._json({"spans": GLOBAL_TRACER.spans_for_trace(trace_id)})
         else:
             self._json({"spans": GLOBAL_TRACER.recent()})
 
@@ -470,9 +571,20 @@ class HTTPServer(ThreadingHTTPServer):
 
         exc = sys.exc_info()[1]
         if isinstance(
-            exc, (ConnectionResetError, BrokenPipeError, TimeoutError)
+            exc,
+            (ConnectionResetError, BrokenPipeError, TimeoutError,
+             ConnectionAbortedError),
         ):
             return  # routine client teardown, not a server fault
+        if self.ssl_context is not None:
+            import ssl
+
+            if isinstance(exc, ssl.SSLError):
+                # failed/aborted client handshake (plaintext speaker on
+                # the TLS port, cert rejected by a strict client): the
+                # client's problem, logged by the client — a per-event
+                # server traceback would spray the log under portscans
+                return
         super().handle_error(request, client_address)
 
     def __init__(self, addr: tuple[str, int], api, stats: StatsClient | None = None):
@@ -482,6 +594,13 @@ class HTTPServer(ThreadingHTTPServer):
         self.stats = stats or StatsClient()
         self.node_id = "local"
         self.long_query_time = 0.0
+        # device-probe gate: the runtime Server swaps in a hook that
+        # blocks query/import dispatch (bounded) until the backend probe
+        # verdict lands — True = proceed, False = serve 503 + Retry-After
+        self.gate = lambda: True
+        # cluster layer swaps in a cross-node trace collector:
+        # trace_id -> {node_id: [span dicts]} for stitched chrome export
+        self.trace_fetch = None
         # the runtime Server replaces this with its configured Logger's
         # log; the default gives standalone HTTPServers the same sink
         from pilosa_tpu.utils.log import Logger

@@ -19,6 +19,9 @@ from pilosa_tpu.utils.config import Config
 
 # process-wide device-backend probe verdict (backends are process-global)
 _DEVICE_PROBE_OK: bool | None = None
+# mesh-attach failure is process-global too (same import/backend error
+# for every Server); warn once, not once per server
+_MESH_ATTACH_WARNED = False
 
 
 class Server:
@@ -53,6 +56,13 @@ class Server:
         self._anti_entropy_timer: threading.Timer | None = None
         self._closed = False
         self._mesh_attach_thread: threading.Thread | None = None
+        # set when the attach thread has finished (probe verdict + pin
+        # decision landed). Starts UNSET so the gate holds queries from
+        # the instant the listener serves — the attach thread is only
+        # created later in open() (after the multihost join), and a gate
+        # keyed on the thread object alone would wave traffic through
+        # that window straight into an unprobed backend init.
+        self._mesh_ready = threading.Event()
 
     def open(self) -> None:
         """holder load → HTTP up → cluster join → background loops
@@ -83,6 +93,7 @@ class Server:
         self.http.node_id = self.config.node_id
         self.http.long_query_time = self.config.long_query_time
         self.http.log = self.logger.log
+        self.http.gate = self._query_gate
         if self.config.seeds or self.config.coordinator:
             from pilosa_tpu.parallel.cluster import Cluster
 
@@ -173,6 +184,12 @@ class Server:
 
     def _attach_mesh_when_ready(self) -> None:
         try:
+            self._attach_mesh_inner()
+        finally:
+            self._mesh_ready.set()  # verdict landed (attached or host path)
+
+    def _attach_mesh_inner(self) -> None:
+        try:
             timeout_s = self.config.device_init_timeout
             if timeout_s > 0 and not self._probe_device_backend(timeout_s):
                 # the accelerator cannot be trusted to init: pin THIS
@@ -193,10 +210,37 @@ class Server:
                 return  # probe/pin decided; nothing to attach
             ctx = self._make_mesh_context()
         except Exception as e:  # noqa: BLE001 — backend init is best-effort
-            self.logger.log(f"mesh attach failed (serving host path): {e}")
+            global _MESH_ATTACH_WARNED
+            if not _MESH_ATTACH_WARNED:
+                _MESH_ATTACH_WARNED = True
+                self.logger.log(f"mesh attach failed (serving host path): {e}")
             return
         if not self._closed:
             self.api.attach_mesh(ctx)
+
+    def _query_gate(self, wait: bool = True) -> bool:
+        """Hold query/import dispatch off JAX until the device-probe
+        verdict lands (ADVICE r5 medium): a query during the probe window
+        would initialize the unpinned — possibly wedged — accelerator
+        backend in-process, hang uninterruptibly, and hold JAX's
+        process-global init lock so the post-probe CPU pin could never
+        recover. Keyed on the ``_mesh_ready`` event (set when the attach
+        thread finishes), which is unset from construction — so the gate
+        also covers the open() window where the listener already serves
+        but the attach thread hasn't been created yet. With ``wait``,
+        blocks up to ``query_gate_wait`` for the verdict; past that the
+        HTTP layer serves 503 + Retry-After. ``wait=False`` is for the
+        internal fan-out route, whose caller's RPC timeout (30s) is
+        shorter than the gate wait — it must fail fast and let the
+        coordinator retry, not hang the RPC into a timeout.
+        ``queries_gated`` counts every request that arrived inside the
+        window."""
+        if self._mesh_ready.is_set():
+            return True
+        self.stats.count("queries_gated")
+        if not wait:
+            return False
+        return self._mesh_ready.wait(self.config.query_gate_wait)
 
     def wait_mesh(self, timeout: float | None = None) -> bool:
         """Block until the off-thread mesh attach finishes (tests and
@@ -252,6 +296,12 @@ class Server:
 
     def close(self) -> None:
         self._closed = True
+        # reap the attach thread (bounded — a wedged probe must not hang
+        # shutdown): a daemon thread logging after close would otherwise
+        # interleave with the embedding process's own output
+        t = self._mesh_attach_thread
+        if t is not None:
+            t.join(timeout=10.0)
         if self.diagnostics is not None:
             self.diagnostics.close()
         if self._anti_entropy_timer is not None:

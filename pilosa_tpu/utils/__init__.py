@@ -1,6 +1,6 @@
 """X1 cross-cutting utilities: stats, tracing, config, logging."""
 
-from pilosa_tpu.utils.stats import NopStats, StatsClient
+from pilosa_tpu.utils.stats import Histogram, NopStats, StatsClient
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER, Tracer
 
-__all__ = ["StatsClient", "NopStats", "Tracer", "GLOBAL_TRACER"]
+__all__ = ["StatsClient", "NopStats", "Histogram", "Tracer", "GLOBAL_TRACER"]

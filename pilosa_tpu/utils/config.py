@@ -8,7 +8,11 @@ template). Same precedence: flags > env > file > defaults.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the stdlib module's PyPI ancestor
+    import tomli as tomllib
 from dataclasses import dataclass, field, fields
 
 
@@ -38,6 +42,11 @@ class Config:
     # indefinitely inside backend init. 0 disables the probe (trust the
     # accelerator to come up).
     device_init_timeout: float = 300.0
+    # seconds a query/import arriving DURING the device probe window
+    # waits for the verdict before being served 503 + Retry-After (the
+    # probe gate keeps first JAX use off a possibly-wedged backend; see
+    # Server._query_gate). 0 = never wait, 503 immediately while probing.
+    query_gate_wait: float = 60.0
     # multi-host process group (jax.distributed; reference analogue:
     # gossip seeds — here membership is static). Setting
     # coordinator_address makes Server.open() join the group before any
@@ -145,6 +154,7 @@ def config_template() -> str:
         "mesh-enabled = true\n"
         "mesh-words-axis = 1\n"
         "device-init-timeout = 300.0\n"
+        "query-gate-wait = 60.0\n"
         'metric-service = "prometheus"\n'
         'tls-certificate = ""\n'
         'tls-key = ""\n'

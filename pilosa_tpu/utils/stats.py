@@ -8,9 +8,107 @@ replaces the adapter zoo; both wire formats read from it.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
 from collections import defaultdict
+
+
+def _log_buckets() -> tuple[float, ...]:
+    """Log-spaced latency boundaries, 1-2.5-5 per decade from 100 µs to
+    500 s — ~3 buckets/decade keeps quantile error within the decade
+    step while spanning sub-ms kernel dispatches through wedged-device
+    timeouts. Roughly the Prometheus client default, extended down."""
+    out = []
+    for exp in range(-4, 3):
+        for mant in (1.0, 2.5, 5.0):
+            out.append(mant * 10.0**exp)
+    return tuple(out)
+
+
+DEFAULT_BUCKETS = _log_buckets()
+
+
+class Histogram:
+    """Log-bucketed latency histogram with percentile snapshots and
+    Prometheus ``_bucket``/``_sum``/``_count`` exposition (reference:
+    the statsd adapter's Histogram/Timing fed per-tag distributions;
+    here the in-process registry keeps the distribution itself so
+    p50/p95/p99 are readable without a statsd backend). Thread-safe:
+    ``observe`` takes a per-histogram lock, so concurrent HTTP handler
+    threads never lose increments."""
+
+    __slots__ = ("buckets", "counts", "count", "sum", "_lock")
+
+    def __init__(self, buckets: tuple[float, ...] | None = None):
+        self.buckets = tuple(buckets) if buckets is not None else DEFAULT_BUCKETS
+        # counts[i] observations ≤ buckets[i]; counts[-1] is the +Inf bucket
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.count = 0
+        self.sum = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum += value
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile (q in [0, 1]) by linear interpolation
+        within the containing bucket — same estimator as PromQL's
+        histogram_quantile, so dashboards and snapshots agree. Returns
+        the largest finite boundary for observations in +Inf."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if cum + c >= rank:
+                if i >= len(self.buckets):  # +Inf bucket
+                    return self.buckets[-1]
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                hi = self.buckets[i]
+                frac = (rank - cum) / c
+                return lo + (hi - lo) * frac
+            cum += c
+        return self.buckets[-1]
+
+    def totals(self) -> tuple[int, float]:
+        """(count, sum) under one lock acquisition — the exposition path
+        reads these per scrape and must not pay for percentiles."""
+        with self._lock:
+            return self.count, self.sum
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            count, total = self.count, self.sum
+        return {
+            "count": count,
+            "totalSeconds": total,
+            "p50": self.percentile(0.50),
+            "p95": self.percentile(0.95),
+            "p99": self.percentile(0.99),
+        }
+
+    def cumulative(self) -> list[tuple[float, int]]:
+        """[(le, cumulative_count), ...] ending at (inf, count) — the
+        Prometheus exposition shape."""
+        with self._lock:
+            counts = list(self.counts)
+        out = []
+        cum = 0
+        for le, c in zip(self.buckets, counts):
+            cum += c
+            out.append((le, cum))
+        out.append((float("inf"), cum + counts[-1]))
+        return out
 
 
 class StatsClient:
@@ -19,7 +117,7 @@ class StatsClient:
         self._lock = threading.Lock()
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
-        self._timings: dict[tuple, list] = defaultdict(lambda: [0, 0.0])
+        self._timings: dict[tuple, Histogram] = {}
 
     @staticmethod
     def _key(name: str, tags: dict | None) -> tuple:
@@ -34,10 +132,18 @@ class StatsClient:
             self._gauges[self._key(name, tags)] = value
 
     def timing(self, name: str, seconds: float, tags: dict | None = None) -> None:
+        key = self._key(name, tags)
         with self._lock:
-            entry = self._timings[self._key(name, tags)]
-            entry[0] += 1
-            entry[1] += seconds
+            hist = self._timings.get(key)
+            if hist is None:
+                hist = self._timings[key] = Histogram()
+        hist.observe(seconds)
+
+    def histogram(self, name: str, tags: dict | None = None) -> Histogram | None:
+        """The live Histogram behind a timer series (tests, bench, and
+        the profile surface read percentiles through this)."""
+        with self._lock:
+            return self._timings.get(self._key(name, tags))
 
     def close(self) -> None:
         """Release emission resources (no-op for registry-only clients)."""
@@ -64,36 +170,57 @@ class StatsClient:
             fmt = lambda k: k[0] + (
                 "{" + ",".join(f"{t}={v}" for t, v in k[1]) + "}" if k[1] else ""
             )
-            return {
-                "counters": {fmt(k): v for k, v in self._counters.items()},
-                "gauges": {fmt(k): v for k, v in self._gauges.items()},
-                "timings": {
-                    fmt(k): {"count": c, "totalSeconds": s}
-                    for k, (c, s) in self._timings.items()
-                },
-            }
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            timings = dict(self._timings)
+        return {
+            "counters": {fmt(k): v for k, v in counters.items()},
+            "gauges": {fmt(k): v for k, v in gauges.items()},
+            "timings": {fmt(k): h.snapshot() for k, h in timings.items()},
+        }
+
+    def _timing_family(self, name: str) -> str:
+        """Timer series name → Prometheus metric family: the _seconds
+        unit suffix is appended once (call sites already named the hot
+        timers *_seconds)."""
+        base = f"{self.prefix}_{name}"
+        return base if name.endswith("_seconds") else base + "_seconds"
 
     def prometheus(self) -> str:
-        """Prometheus text exposition (reference: /metrics)."""
+        """Prometheus text exposition (reference: /metrics). Timers
+        expose as real histograms — cumulative ``_bucket{le=...}`` series
+        plus ``_sum``/``_count`` — so p95/p99 are PromQL-derivable."""
         lines = []
         with self._lock:
-            def labels(k):
-                if not k[1]:
-                    return ""
-                inner = ",".join(f'{t}="{v}"' for t, v in k[1])
-                return "{" + inner + "}"
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            timings = sorted(self._timings.items())
 
-            for k, v in sorted(self._counters.items()):
-                lines.append(f"# TYPE {self.prefix}_{k[0]} counter")
-                lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
-            for k, v in sorted(self._gauges.items()):
-                lines.append(f"# TYPE {self.prefix}_{k[0]} gauge")
-                lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
-            for k, (c, s) in sorted(self._timings.items()):
-                base = f"{self.prefix}_{k[0]}"
-                lines.append(f"# TYPE {base}_seconds summary")
-                lines.append(f"{base}_seconds_count{labels(k)} {c}")
-                lines.append(f"{base}_seconds_sum{labels(k)} {s}")
+        def labels(k, extra: str = ""):
+            inner = ",".join(f'{t}="{v}"' for t, v in k[1])
+            if extra:
+                inner = f"{inner},{extra}" if inner else extra
+            return "{" + inner + "}" if inner else ""
+
+        for k, v in counters:
+            lines.append(f"# TYPE {self.prefix}_{k[0]} counter")
+            lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
+        for k, v in gauges:
+            lines.append(f"# TYPE {self.prefix}_{k[0]} gauge")
+            lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
+        seen_families = set()
+        for k, hist in timings:
+            family = self._timing_family(k[0])
+            if family not in seen_families:
+                seen_families.add(family)
+                lines.append(f"# TYPE {family} histogram")
+            for le, cum in hist.cumulative():
+                le_str = "+Inf" if le == float("inf") else f"{le:g}"
+                le_label = labels(k, f'le="{le_str}"')
+                lines.append(f"{family}_bucket{le_label} {cum}")
+            count, total = hist.totals()
+            lines.append(f"{family}_sum{labels(k)} {total}")
+            lines.append(f"{family}_count{labels(k)} {count}")
         return "\n".join(lines) + "\n"
 
 

@@ -103,3 +103,15 @@ def test_apply_jax_platform_env_never_widens(monkeypatch):
         assert jax.config.jax_platforms == "cpu"
     finally:
         jax.config.update("jax_platforms", "cpu")  # leave the suite pinned
+
+    # ADVICE r5: an explicit JAX_PLATFORMS=cpu is ALWAYS honored, even
+    # when the in-process pin names only an accelerator — a CPU init
+    # cannot hang, and dropping the operator's cpu pin re-enters the
+    # wedged transport the override was meant to avoid
+    jax.config.update("jax_platforms", "axon")
+    try:
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        _apply_jax_platform_env()
+        assert jax.config.jax_platforms == "cpu"
+    finally:
+        jax.config.update("jax_platforms", "cpu")  # leave the suite pinned

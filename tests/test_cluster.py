@@ -1954,3 +1954,101 @@ def test_translate_sender_holes_propagate_and_tombstone():
     # bounded by the rotating request window instead
     assert fresh.holes_for_pull() == [2]
     assert fresh.holes_for_pull(limit=1) == [2]
+
+
+# ------------------------------------------------------------ observability
+def test_trace_propagates_across_fanout(tmp_path):
+    """One user query fanned out across 2 nodes yields ONE trace: the
+    remote node's spans carry the coordinator's trace_id and parent onto
+    the fan-out span, and the stitched chrome export nests them inside
+    the coordinating HTTP span's time range."""
+    import time as _time
+
+    servers, ports, _ = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 3 for s in range(6)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 6, "columnIDs": cols})
+        # both nodes hold shards (the distribution guarantee other
+        # cluster tests rely on), so the query must fan out
+        r = call(ports[0], "POST", "/index/i/query?profile=true",
+                 b"Count(Row(f=1))")
+        assert r["results"] == [6]
+        prof = r["profile"]
+        tid = prof["traceID"]
+        remote_legs = [e for e in prof["fanout"] if "node" in e
+                       and e["node"] != servers[0].cluster.me.id]
+        assert remote_legs, "query did not fan out to the peer"
+        leg = remote_legs[0]
+        assert leg["call"] == "Count" and leg["seconds"] > 0
+        assert leg["bytes"] > 0 and leg["shards"]
+        # shard groups cover every shard exactly once
+        covered = sorted(s for e in prof["fanout"] for s in e["shards"])
+        assert covered == list(range(6))
+
+        _time.sleep(0.1)  # let the remote handler thread buffer its span
+        coord = call(ports[0], "GET", f"/debug/traces?trace_id={tid}")["spans"]
+        remote = call(ports[1], "GET", f"/debug/traces?trace_id={tid}")["spans"]
+        assert coord and remote
+        assert all(s["traceID"] == tid for s in coord + remote)
+        # remote spans parent onto the coordinator's fan-out span
+        fanout_ids = {s["spanID"] for s in coord if s["name"] == "cluster.fanout"}
+        remote_http = [s for s in remote if s["name"] == "http.internal"]
+        assert remote_http and remote_http[0]["parentSpanID"] in fanout_ids
+        # ... and the remote EXECUTOR spans hang off that remote HTTP span
+        remote_exec = [s for s in remote if s["name"].startswith("executor.")]
+        assert remote_exec
+        remote_ids = {s["spanID"] for s in remote}
+        assert all(s["parentSpanID"] in remote_ids for s in remote_exec)
+
+        # stitched chrome export: one file, one pid per node, remote
+        # spans time-nested inside the coordinating HTTP span
+        ct = call(ports[0], "GET",
+                  f"/debug/traces?format=chrome&trace_id={tid}")
+        events = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+        assert len({e["pid"] for e in events}) == 2
+        http_ev = next(e for e in events if e["name"] == "http.query")
+        rexec_evs = [e for e in events
+                     if e["name"].startswith("executor.")
+                     and e["args"]["spanID"] in {s["spanID"] for s in remote_exec}]
+        assert rexec_evs
+        for ev in rexec_evs:
+            assert http_ev["ts"] <= ev["ts"]
+            assert ev["ts"] + ev["dur"] <= http_ev["ts"] + http_ev["dur"] + 1
+        # process metadata names both nodes
+        names = {e["args"]["name"] for e in ct["traceEvents"] if e["ph"] == "M"}
+        assert len(names) == 2
+
+        # fan-out RPC latency landed in the coordinator's histograms
+        hist = servers[0].stats.histogram(
+            "fanout_rpc_seconds", {"node": leg["node"]}
+        )
+        assert hist is not None and hist.count >= 1
+    finally:
+        shutdown(servers)
+
+
+def test_long_query_log_names_slow_shard_group(tmp_path):
+    """Slow-query log lines carry the trace id and the slowest
+    node/shard group from the per-query profile."""
+    log_file = tmp_path / "coord.log"
+    servers, ports, seeds = make_cluster(tmp_path, n=2)
+    try:
+        servers[0].http.long_query_time = 1e-9  # everything is "long"
+        lines = []
+        servers[0].http.log = lines.append
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 3 for s in range(6)]
+        call(ports[0], "POST", "/index/i/field/f/import",
+             {"rowIDs": [1] * 6, "columnIDs": cols})
+        call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        long_lines = [ln for ln in lines if "long query" in ln]
+        assert long_lines
+        assert "trace=" in long_lines[-1]
+        assert "slowest=Count" in long_lines[-1]
+        assert "node=" in long_lines[-1] and "shards=" in long_lines[-1]
+    finally:
+        shutdown(servers)

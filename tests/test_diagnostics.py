@@ -83,7 +83,10 @@ def test_diagnostics_disabled(tmp_path):
 
 
 def test_generate_config_subcommand(capsys):
-    import tomllib
+    try:
+        import tomllib
+    except ImportError:  # Python < 3.11 — same shim as utils/config.py
+        import tomli as tomllib
 
     assert cli.main(["generate-config"]) == 0
     out = capsys.readouterr().out
@@ -91,6 +94,7 @@ def test_generate_config_subcommand(capsys):
     assert cfg["bind"] == "127.0.0.1:10101"
     assert cfg["diagnostics-interval"] == 3600.0
     assert cfg["long-query-time"] == 0.0
+    assert cfg["query-gate-wait"] == 60.0
 
 
 def test_pprof_profile_endpoint(srv):

@@ -257,6 +257,95 @@ def test_long_query_log_to_file(tmp_path):
     assert "long query" in text and "index=lq" in text
 
 
+def test_query_profile_schema(srv):
+    """?profile=true returns a per-call / per-shard timing breakdown;
+    the default (profile-off) response shape is unchanged."""
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    call(srv, "POST", "/index/i/query", b"Set(1, f=1) Set(3, f=2)")
+    plain = call(srv, "POST", "/index/i/query", b"Count(Row(f=1))")
+    assert "profile" not in plain and plain["results"] == [1]
+    r = call(srv, "POST", "/index/i/query?profile=true", b"Count(Row(f=1))")
+    assert r["results"] == [1]
+    p = r["profile"]
+    assert set(p) >= {"traceID", "totalSeconds", "calls", "fanout"}
+    assert len(p["traceID"]) == 32  # 128-bit hex
+    assert p["totalSeconds"] > 0
+    counts = [e for e in p["calls"] if e["call"] == "Count"]
+    assert counts and counts[0]["seconds"] >= 0
+    assert counts[0]["shards"] == [0]
+    # the deferred-readback wave is accounted separately
+    assert any(e["call"] == "_readback" for e in p["calls"])
+    # single-node: no fan-out legs
+    assert p["fanout"] == []
+
+
+def test_trace_spans_have_identity(srv):
+    """Every recorded span carries 128-bit trace / 64-bit span ids, and
+    /debug/traces?trace_id= filters to one trace."""
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    r = call(srv, "POST", "/index/i/query?profile=true", b"Count(Row(f=1))")
+    tid = r["profile"]["traceID"]
+    spans = call(srv, "GET", f"/debug/traces?trace_id={tid}")["spans"]
+    assert spans and all(s["traceID"] == tid for s in spans)
+    names = {s["name"] for s in spans}
+    assert "http.query" in names and "pql.query" in names
+    assert any(s["name"].startswith("executor.") for s in spans)
+    by_id = {s["spanID"]: s for s in spans}
+    # executor span parents (transitively) onto the HTTP span
+    execs = [s for s in spans if s["name"] == "executor.Count"]
+    assert execs and by_id[execs[0]["parentSpanID"]]["name"] == "pql.query"
+    assert all(len(s["spanID"]) == 16 for s in spans)
+    # chrome export of one trace is well-formed
+    ct = call(srv, "GET", f"/debug/traces?format=chrome&trace_id={tid}")
+    events = [e for e in ct["traceEvents"] if e["ph"] == "X"]
+    assert events and all(e["args"]["traceID"] == tid for e in events)
+
+
+def test_metrics_query_seconds_histogram(srv):
+    """/metrics exposes query_seconds as a Prometheus histogram:
+    cumulative _bucket{le=} series plus _sum/_count."""
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    call(srv, "POST", "/index/i/query", b"Count(Row(f=1))")
+    text = call(srv, "GET", "/metrics", raw=True).decode()
+    assert "# TYPE pilosa_tpu_query_seconds histogram" in text
+    assert 'pilosa_tpu_query_seconds_bucket{index="i",le="+Inf"} 1' in text
+    assert "pilosa_tpu_query_seconds_sum" in text
+    assert "pilosa_tpu_query_seconds_count" in text
+    # the executor's per-call histograms ride the same exposition
+    assert "pilosa_tpu_executor_call_seconds_bucket" in text
+
+
+def test_query_gated_during_device_probe(tmp_path):
+    """A query arriving while the device probe is still deciding must
+    not reach JAX: it waits up to query-gate-wait, then gets 503 with
+    Retry-After, and queries_gated counts the trip (ADVICE r5 medium).
+    The gate is keyed on the _mesh_ready event (unset from construction),
+    so it also covers the window before the attach thread exists."""
+    s = Server(Config(bind="127.0.0.1:0", data_dir=str(tmp_path / "d"),
+                      anti_entropy_interval=0, query_gate_wait=0.1))
+    s.open()
+    try:
+        s.wait_mesh()
+        s._mesh_ready.clear()  # simulate a still-undecided probe
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "POST", "/index/x/query", b"Count(Row(f=1))")
+        assert e.value.code == 503
+        assert e.value.headers.get("Retry-After")
+        assert s.stats.expvar()["counters"]["queries_gated"] == 1
+        s._mesh_ready.set()
+        # verdict landed: the same query now dispatches (400 path, not
+        # 503 — the index doesn't exist, which is the point: it got
+        # PAST the gate)
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "POST", "/index/x/query", b"Count(Row(f=1))")
+        assert e.value.code == 400
+    finally:
+        s.close()
+
+
 def test_explicit_zero_range_enforced(srv):
     """ADVICE r3: a field declared with range [0, 0] (only value 0
     legal) must enforce it — the 0/0 default means unbounded only when

@@ -50,12 +50,20 @@ def _device_set(arr) -> set:
     return {d.id for d in arr.sharding.device_set}
 
 
+_NO_MESH = not hasattr(jax, "shard_map")  # pre-0.5 jax: attach falls back
+_need_mesh = pytest.mark.skipif(
+    _NO_MESH, reason="jax.shard_map unavailable; mesh executor cannot attach"
+)
+
+
+@_need_mesh
 def test_server_uses_mesh_on_multidevice_host(srv):
     assert len(jax.devices()) == 8  # conftest's virtual platform
     assert srv.api.mesh_ctx is not None
     assert srv.api.mesh_ctx.n_devices == 8
 
 
+@_need_mesh
 def test_query_stacks_carry_namedsharding(srv):
     call(srv, "POST", "/index/mi", {})
     call(srv, "POST", "/index/mi/field/f", {})

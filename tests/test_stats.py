@@ -1,0 +1,163 @@
+"""Histogram + stats registry tests: bucket boundaries, percentile
+accuracy on known distributions, Prometheus histogram exposition
+(cumulative le labels, +Inf == count), and thread-safety under
+concurrent observe."""
+
+import math
+import threading
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.utils.stats import (
+    DEFAULT_BUCKETS,
+    Histogram,
+    NopStats,
+    StatsClient,
+)
+
+
+# ------------------------------------------------------------- histogram
+def test_default_buckets_log_spaced():
+    # 1-2.5-5 per decade, 100 µs .. 500 s, strictly increasing
+    assert DEFAULT_BUCKETS[0] == pytest.approx(1e-4)
+    assert DEFAULT_BUCKETS[-1] == pytest.approx(500.0)
+    assert all(b < a for b, a in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:]))
+    # log-spacing: the boundary ratio never exceeds one decade step
+    ratios = [a / b for b, a in zip(DEFAULT_BUCKETS, DEFAULT_BUCKETS[1:])]
+    assert max(ratios) <= 2.5 + 1e-9
+
+
+def test_bucket_boundaries_inclusive():
+    """An observation exactly ON a boundary lands in that boundary's
+    bucket (le is ≤, Prometheus semantics)."""
+    h = Histogram(buckets=(0.01, 0.1, 1.0))
+    h.observe(0.01)
+    h.observe(0.1)
+    h.observe(1.0)
+    h.observe(2.0)  # +Inf
+    cum = dict(h.cumulative())
+    assert cum[0.01] == 1
+    assert cum[0.1] == 2
+    assert cum[1.0] == 3
+    assert cum[float("inf")] == 4 == h.count
+
+
+def test_percentiles_on_known_distribution():
+    """Uniform [0, 1): every quantile must land within one bucket step
+    of the true value (the log-bucket error bound)."""
+    h = Histogram()
+    rng = np.random.default_rng(7)
+    xs = rng.random(20_000)
+    for x in xs:
+        h.observe(float(x))
+    for q in (0.5, 0.95, 0.99):
+        est = h.percentile(q)
+        true = float(np.quantile(xs, q))
+        # containing-bucket interpolation: error bounded by the bucket
+        # width around the true quantile (≤ 2.5x log step)
+        assert true / 2.5 <= est <= true * 2.5, (q, est, true)
+
+
+def test_percentiles_point_mass():
+    h = Histogram()
+    for _ in range(1000):
+        h.observe(0.004)  # inside the (0.0025, 0.005] bucket
+    for q in (0.5, 0.95, 0.99):
+        assert 0.0025 <= h.percentile(q) <= 0.005
+
+
+def test_percentile_empty_and_overflow():
+    h = Histogram(buckets=(0.1, 1.0))
+    assert h.percentile(0.99) == 0.0
+    h.observe(50.0)  # +Inf bucket
+    assert h.percentile(0.5) == 1.0  # clamped to the largest boundary
+    snap = h.snapshot()
+    assert snap["count"] == 1 and snap["totalSeconds"] == pytest.approx(50.0)
+
+
+def test_thread_safety_concurrent_observe():
+    h = Histogram()
+    n, per = 8, 5000
+
+    def worker(k):
+        for i in range(per):
+            h.observe(0.001 * (1 + (i + k) % 7))
+
+    threads = [threading.Thread(target=worker, args=(k,)) for k in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert h.count == n * per
+    assert h.cumulative()[-1][1] == n * per
+    assert h.sum == pytest.approx(
+        sum(0.001 * (1 + (i + k) % 7) for k in range(n) for i in range(per))
+    )
+
+
+# -------------------------------------------------------------- registry
+def _parse_prometheus(text):
+    """Exposition text → {metric: {(label_tuple): value}}."""
+    out = {}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name_labels, value = ln.rsplit(" ", 1)
+        if "{" in name_labels:
+            name, rest = name_labels.split("{", 1)
+            labels = tuple(sorted(rest.rstrip("}").split(",")))
+        else:
+            name, labels = name_labels, ()
+        out.setdefault(name, {})[labels] = float(value)
+    return out
+
+
+def test_prometheus_histogram_exposition_parses():
+    c = StatsClient()
+    for v in (0.0002, 0.003, 0.003, 0.04, 2.0):
+        c.timing("query_seconds", v, tags={"index": "i"})
+    text = c.prometheus()
+    assert "# TYPE pilosa_tpu_query_seconds histogram" in text
+    parsed = _parse_prometheus(text)
+    buckets = parsed["pilosa_tpu_query_seconds_bucket"]
+    # le labels are CUMULATIVE: monotone nondecreasing in le order
+    by_le = sorted(
+        (
+            (
+                math.inf
+                if 'le="+Inf"' in labels
+                else float(next(l for l in labels if l.startswith('le="'))[4:-1]),
+                v,
+            )
+            for labels, v in buckets.items()
+        )
+    )
+    values = [v for _, v in by_le]
+    assert values == sorted(values)
+    # the +Inf bucket equals _count
+    count = parsed["pilosa_tpu_query_seconds_count"][('index="i"',)]
+    assert by_le[-1][0] == math.inf and by_le[-1][1] == count == 5
+    assert parsed["pilosa_tpu_query_seconds_sum"][('index="i"',)] == pytest.approx(
+        0.0002 + 0.003 + 0.003 + 0.04 + 2.0
+    )
+    # every bucket line carries the series labels alongside le
+    assert all('index="i"' in labels for labels in buckets)
+
+
+def test_timer_feeds_histogram_and_expvar():
+    c = StatsClient()
+    with c.timer("op_seconds", tags={"kind": "x"}):
+        pass
+    h = c.histogram("op_seconds", {"kind": "x"})
+    assert h is not None and h.count == 1
+    snap = c.expvar()["timings"]['op_seconds{kind=x}']
+    assert snap["count"] == 1
+    assert {"p50", "p95", "p99", "totalSeconds"} <= set(snap)
+
+
+def test_nop_stats_timing_noop():
+    c = NopStats()
+    c.timing("query_seconds", 1.0)
+    assert c.histogram("query_seconds") is None
+    assert c.prometheus() == "\n"
