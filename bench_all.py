@@ -90,6 +90,15 @@ def line(metric, value, unit, vs, extra=None):
     print(json.dumps(rec), flush=True)
 
 
+def rtt_capped(p50_ms):
+    """True when sync throughput sits within 10% of 1/RTT — the
+    machine-readable marker that this sync row is transport-floored
+    (the server-side p50 alongside it is then the progress signal)."""
+    if _RTT_MS <= 0 or p50_ms <= 0:
+        return False
+    return abs(1 / p50_ms - 1 / _RTT_MS) <= 0.1 * (1 / _RTT_MS)
+
+
 def config1_pql_single_shard():
     """End-to-end PQL Intersect+Count on 1M columns through the executor
     (parse → plan → device kernels) vs host roaring set-op."""
@@ -117,22 +126,35 @@ def config1_pql_single_shard():
         return int(np.bitwise_count(ra & rb).sum())
 
     assert e.execute("bench", pql)[0] == host()
-    # pipelined throughput of the compiled program (a serving system
-    # overlaps readbacks; the sync path adds only the transport RTT)
+    # the engine the cost router picks for this query (on any box with a
+    # sub-ms host path this is "host": 65k words of work never amortizes
+    # a device dispatch — the round-5 0.04x row was exactly this query
+    # paying ~70 ms of tunnel RTT for ~65 us of work)
     call = parse(pql)[0].children[0]
     idx_obj = h.index("bench")
+    route = e.route_for("bench", pql)
 
-    def dev():
-        return e.compiler.count_async(idx_obj, call, [0])
+    if route == "host":
+
+        def dev():
+            return e.compiler.host.count(idx_obj, call, [0])
+
+    else:
+        # pipelined throughput of the compiled program (a serving system
+        # overlaps readbacks; the sync path adds only the transport RTT)
+        def dev():
+            return e.compiler.count_async(idx_obj, call, [0])
 
     t_dev = timeit(dev, 50)
     t_host = timeit(host, 50)
-    line("pql_intersect_count_1M_qps", 1 / t_dev, "qps", t_host / t_dev)
+    line("pql_intersect_count_1M_qps", 1 / t_dev, "qps", t_host / t_dev,
+         extra={"route": route})
 
     # SYNC multi-count requests: counts dispatch async in program order
     # and resolve in ONE readback wave, so a 16-count request pays one
     # transport RTT instead of 16 — counts/s here ≈ 16× the
-    # single-count sync rate on a high-RTT transport
+    # single-count sync rate on a high-RTT transport. (Host-routed, the
+    # batch and the single query are both dispatch-free.)
     multi = " ".join([pql] * 16)
     assert e.execute("bench", multi) == [host()] * 16  # the batched wave
 
@@ -146,6 +168,7 @@ def config1_pql_single_shard():
         16 / t_multi,
         "counts/s",
         (16 / t_multi) * t_single,
+        extra={"route": route, "rtt_capped": rtt_capped(t_single * 1e3)},
     )
 
 
@@ -217,11 +240,13 @@ def config3_topn_groupby():
     got = e.execute("taxi", "TopN(cab_type, n=10)")[0]
     want_counts = np.bincount(cab_rows.astype(np.int64), minlength=256)
     assert [p["count"] for p in got] == sorted(want_counts.tolist(), reverse=True)[:10]
+    topn_route = e.route_for("taxi", "TopN(cab_type, n=10)")
     t_topn, topn_p50, topn_tails = lat_stats(
         lambda: e.execute("taxi", "TopN(cab_type, n=10)"), 10
     )
     t_host = timeit(host_topn, 10)
-    line("executor_topn_qps", 1 / t_topn, "qps", t_host / t_topn)
+    line("executor_topn_qps", 1 / t_topn, "qps", t_host / t_topn,
+         extra={"route": topn_route, "rtt_capped": rtt_capped(topn_p50)})
     # tunnel-independent server latency (VERDICT r4 weak #7: sync p50s
     # were unreadable behind the ~70 ms tunnel RTT constant); the extra
     # keys carry the histogram tails from the same sample
@@ -245,6 +270,9 @@ def config3_topn_groupby():
     for entry in gb[:20]:
         c, p = entry["group"][0]["rowID"], entry["group"][1]["rowID"]
         assert entry["count"] == int(hg[c * 8 + p]), (c, p)
+    gb_route = e.route_for(
+        "taxi", "GroupBy(Rows(cab_type), Rows(passenger_count), limit=100)"
+    )
     t_gb, gb_p50, gb_tails = lat_stats(
         lambda: e.execute(
             "taxi", "GroupBy(Rows(cab_type), Rows(passenger_count), limit=100)"
@@ -252,7 +280,8 @@ def config3_topn_groupby():
         5,
     )
     t_hgb = timeit(host_groupby, 10)
-    line("executor_groupby_qps", 1 / t_gb, "qps", t_hgb / t_gb)
+    line("executor_groupby_qps", 1 / t_gb, "qps", t_hgb / t_gb,
+         extra={"route": gb_route, "rtt_capped": rtt_capped(gb_p50)})
     line("executor_groupby_server_p50_ms",
          max(0.0, gb_p50 - _RTT_MS), "ms", 1.0, extra=gb_tails)
 
@@ -584,13 +613,25 @@ def config7_cluster_read():
         if hist is not None
         else None
     )
+    # per-node served-query distribution (VERDICT #6): with clients
+    # spread across both replicas and local-preference routing, reads
+    # should split near-evenly — a skewed split here means one replica
+    # is carrying the cluster
+    served = {}
+    for i, s in enumerate(cluster):
+        counters = s.stats.expvar()["counters"]
+        served[f"node{i}"] = int(
+            sum(v for k, v in counters.items() if k.startswith("queries_served"))
+        )
+    extra = dict(tails or {})
+    extra["served_distribution"] = served
     # renamed from cluster_read_qps_2node: the methodology changed in
     # round 5 from single-client 1/latency to 8-client aggregate
     # throughput with replica_n=2 — a new name keeps round-over-round
     # series honest. vs_baseline = scaling vs single-node at the SAME
     # client concurrency (~2x on a multicore host; ~1x on 1 core).
     line("cluster_read_agg_qps_2node", qps_cluster, "qps",
-         qps_cluster / qps_single, extra=tails)
+         qps_cluster / qps_single, extra=extra)
 
 
 def transport_context(emit: bool = True):
@@ -650,8 +691,10 @@ def main():
         transport_context()
         return
     if child:
-        if child == "3":
-            transport_context(emit=False)  # config3's server-p50 splits
+        if child in ("1", "3"):
+            # configs 1/3 stamp rtt_capped + server-p50 splits on their
+            # sync rows — both need the measured RTT floor
+            transport_context(emit=False)
         CONFIGS[child]()
         return
 

@@ -878,6 +878,13 @@ class QueryCompiler:
         self._ones: dict[int, Any] = {}
         self._aot: set[tuple] = set()
         self._scalar_arrays: dict[tuple, Any] = {}
+        # the HOST compilation layer: numpy plans over host-resident
+        # stacks, memoized per plan key (executor/hostpath.py). Hangs off
+        # the compiler so both engines — and their caches — share one
+        # owner; the executor's router picks which one a call runs on.
+        from pilosa_tpu.executor.hostpath import HostEngine
+
+        self.host = HostEngine()
 
     def device_scalars(self, values: list[int]):
         """Device-resident int32 operand vector, cached by VALUE.

@@ -47,6 +47,8 @@ from pilosa_tpu.executor.compile import (
     StackOverBudget,
     _stack_budget,
 )
+from pilosa_tpu.executor.hostpath import HostPlanError
+from pilosa_tpu.executor.router import QueryRouter, estimate_words
 from pilosa_tpu.executor.row import RowResult
 from pilosa_tpu.pql import Call, coerce_timestamp, parse
 from pilosa_tpu.roaring import unpack_words
@@ -202,10 +204,26 @@ class Executor:
             return int(env)
         return max(256 << 20, _stack_budget() // 8)
 
-    def __init__(self, holder: Holder, mesh_ctx=None, stats=None):
+    def __init__(
+        self,
+        holder: Holder,
+        mesh_ctx=None,
+        stats=None,
+        route_mode: str | None = None,
+        router: QueryRouter | None = None,
+    ):
         self.holder = holder
         self.stats = stats  # optional StatsClient for per-call histograms
         self.compiler = QueryCompiler(mesh_ctx)
+        # per-call host/device routing (executor/router.py). Passing an
+        # existing router preserves its calibration across executor
+        # rebuilds (the server's mesh re-attach swaps the Executor but
+        # the measured crossover must not reset to seeds).
+        self.router = (
+            router
+            if router is not None
+            else QueryRouter(mode=route_mode, stats=stats)
+        )
 
     # ------------------------------------------------------------ entry
     def execute(
@@ -230,9 +248,20 @@ class Executor:
         results = []
         for c in calls:
             t0 = time.perf_counter()
+            route, work = self._route(idx, c, shards)
             with GLOBAL_TRACER.span(f"executor.{c.name}", index=index_name):
-                results.append(self._execute_call(idx, c, shards, lazy=True))
+                results.append(
+                    self._execute_call(idx, c, shards, lazy=True, route=route)
+                )
             elapsed = time.perf_counter() - t0
+            if route in ("host", "device"):
+                self.router.record(route)
+                if work > 0:
+                    # feed the calibration: host samples refine host
+                    # throughput/overhead, device samples the dispatch cost
+                    self.router.observe(route, work, elapsed)
+                if self.stats is not None:
+                    self.stats.count("queries_routed", tags={"path": route})
             if self.stats is not None:
                 self.stats.timing(
                     "executor_call_seconds", elapsed, tags={"call": c.name}
@@ -240,7 +269,7 @@ class Executor:
             if prof is not None:
                 if prof_shards is None:
                     prof_shards = self._shards(idx, shards)
-                prof.add_call(c.name, elapsed, prof_shards)
+                prof.add_call(c.name, elapsed, prof_shards, route=route)
         pending = [r for r in results if isinstance(r, _Pending)]
         if pending:
             t0 = time.perf_counter()
@@ -263,6 +292,7 @@ class Executor:
                     i += 1
                 p.value = p.finish(args)
             elapsed = time.perf_counter() - t0
+            self.router.observe_readback(elapsed)
             if self.stats is not None:
                 self.stats.timing("executor_readback_seconds", elapsed)
             if prof is not None:
@@ -277,15 +307,58 @@ class Executor:
         avail = idx.available_shards()
         return sorted(avail) if avail else [0]
 
+    # ------------------------------------------------------------ routing
+    def _route(self, idx: Index, call: Call, shards: list[int] | None):
+        """(route, estimated_work_words) for one top-level call.  Writes
+        route None (no engine choice to make); Rows is metadata-only and
+        always serves host-side.  Reads go through the cost router —
+        decision memoized per plan key (executor/router.py)."""
+        c, sh = call, shards
+        while c.name == "Options" and len(c.children) == 1:
+            sh = c.arg("shards", sh)
+            c = c.children[0]
+        if c.name in WRITE_CALLS:
+            return None, 0
+        if c.name == "Rows":
+            return "host", 0
+        n = len(sh) if sh is not None else max(1, len(idx.available_shards()))
+        work = estimate_words(idx, c, n)
+        if self.router.mode != "auto":
+            return self.router.mode, work
+        return self.router.decide((idx.name, n, repr(c)), work), work
+
+    def route_for(
+        self,
+        index_name: str,
+        query: "str | Call | list[Call]",
+        shards: list[int] | None = None,
+    ) -> str:
+        """The route a query's first call would take right now — the
+        reporting hook bench.py/bench_all.py stamp into their rows."""
+        idx = self.holder.index(index_name)
+        if idx is None:
+            raise ExecutionError(f"index {index_name!r} not found")
+        calls = parse(query) if isinstance(query, str) else query
+        first = calls[0] if isinstance(calls, list) else calls
+        route, _work = self._route(idx, first, shards)
+        return route or "write"
+
     def _execute_call(
-        self, idx: Index, call: Call, shards: list[int] | None, lazy: bool = False
+        self,
+        idx: Index,
+        call: Call,
+        shards: list[int] | None,
+        lazy: bool = False,
+        route: str | None = "device",
     ) -> Any:
         name = call.name
         if name == "Options":
             if len(call.children) != 1:
                 raise ExecutionError("Options() takes exactly one call")
             opt_shards = call.arg("shards", shards)
-            res = self._execute_call(idx, call.children[0], opt_shards, lazy=lazy)
+            res = self._execute_call(
+                idx, call.children[0], opt_shards, lazy=lazy, route=route
+            )
             if isinstance(res, _Pending):
                 # shape at resolve time so Options() args still apply
                 inner = res.finish
@@ -295,9 +368,18 @@ class Executor:
         if name in WRITE_CALLS:
             return self._execute_write(idx, call)
         shard_list = self._shards(idx, shards)
+        host = route == "host"
         try:
             if name in BITMAP_CALLS:
-                words = self._bitmap_words(idx, call, shard_list)
+                if host:
+                    # np.array: the host engine may hand back views of
+                    # live stack memory; the result a client keeps must
+                    # not alias storage a later write scatters into
+                    words = np.array(
+                        self.compiler.host.bitmap_words(idx, call, shard_list)
+                    )
+                else:
+                    words = self._bitmap_words(idx, call, shard_list)
                 res = RowResult(
                     {s: words[i] for i, s in enumerate(shard_list)}
                 )
@@ -307,26 +389,39 @@ class Executor:
             if name == "Count":
                 if len(call.children) != 1:
                     raise ExecutionError("Count() takes exactly one call")
+                if host:
+                    # concrete scalar, no _Pending, no readback wave
+                    return self.compiler.host.count(
+                        idx, call.children[0], shard_list
+                    )
                 pend = _Pending(
                     [self.compiler.count_async(idx, call.children[0], shard_list)],
                     lambda a: int(a[0]),
                 )
                 return pend if lazy else pend.resolve_now()
             if name == "Sum":
-                return self._execute_sum(idx, call, shard_list, lazy=lazy)
+                return self._execute_sum(
+                    idx, call, shard_list, lazy=lazy, host=host
+                )
             if name in ("Min", "Max"):
                 return self._execute_min_max(
-                    idx, call, shard_list, name == "Max", lazy=lazy
+                    idx, call, shard_list, name == "Max", lazy=lazy, host=host
                 )
             if name == "TopN":
-                return self._execute_topn(idx, call, shard_list, lazy=lazy)
+                return self._execute_topn(
+                    idx, call, shard_list, lazy=lazy, host=host
+                )
             if name == "Rows":
                 return self._execute_rows(idx, call, shard_list)
             if name == "GroupBy":
-                return self._execute_group_by(idx, call, shard_list, lazy=lazy)
+                return self._execute_group_by(
+                    idx, call, shard_list, lazy=lazy, host=host
+                )
             if name == "IncludesColumn":
-                return self._execute_includes_column(idx, call, shard_list)
-        except (PlanError, StackOverBudget) as e:
+                return self._execute_includes_column(
+                    idx, call, shard_list, host=host
+                )
+        except (PlanError, StackOverBudget, HostPlanError) as e:
             raise ExecutionError(str(e)) from e
         raise ExecutionError(f"unknown call {name!r}")
 
@@ -473,9 +568,13 @@ class Executor:
         )
 
     def _execute_sum(
-        self, idx: Index, call: Call, shards: list[int], lazy: bool = False
+        self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
+        host: bool = False,
     ):
         field = self._agg_field(idx, call)
+        if host:
+            value, n = self.compiler.host.sum(idx, field, call, shards)
+            return SumCount(value, n)
         slices = self._bsi_stacked(idx, field, shards)
         fplan = self._filter_plan(idx, call, shards)
         if fplan is not None:
@@ -500,9 +599,14 @@ class Executor:
 
     def _execute_min_max(
         self, idx: Index, call: Call, shards: list[int], want_max: bool,
-        lazy: bool = False,
+        lazy: bool = False, host: bool = False,
     ):
         field = self._agg_field(idx, call)
+        if host:
+            value, n = self.compiler.host.min_max(
+                idx, field, call, shards, want_max
+            )
+            return SumCount(value, n)
         slices = self._bsi_stacked(idx, field, shards)
         vmapped = jax.vmap(
             lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
@@ -541,7 +645,8 @@ class Executor:
         return pend if lazy else pend.resolve_now()
 
     def _execute_topn(
-        self, idx: Index, call: Call, shards: list[int], lazy: bool = False
+        self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
+        host: bool = False,
     ):
         field = self._field(idx, self._call_field_name(call))
         n = call.arg("n")
@@ -557,6 +662,14 @@ class Executor:
         if attr_name is not None and not attr_values:
             raise ExecutionError("TopN() attrName requires attrValues")
 
+        if host:
+            pairs = self.compiler.host.topn_pairs(
+                idx, field, call, shards,
+                list(ids) if ids is not None else None,
+            )
+            return self._topn_finish(
+                field, pairs, n, attr_name, attr_values, min_count
+            )
         try:
             matrix, n_rows = self.compiler.stacks.matrix(
                 idx, field, VIEW_STANDARD, shards
@@ -764,25 +877,24 @@ class Executor:
         return {"rows": rows}
 
     def _execute_group_by(
-        self, idx: Index, call: Call, shards: list[int], lazy: bool = False
+        self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
+        host: bool = False,
     ):
         if not call.children or any(ch.name != "Rows" for ch in call.children):
             raise ExecutionError("GroupBy() takes Rows() calls")
         limit = call.arg("limit")
         filter_call = call.arg("filter")
+        if filter_call is not None and not isinstance(filter_call, Call):
+            raise ExecutionError("GroupBy filter must be a call")
         aggregate = call.arg("aggregate")
         if aggregate is not None and not (
             isinstance(aggregate, Call) and aggregate.name == "Sum"
         ):
             raise ExecutionError("GroupBy aggregate must be Sum(field=...)")
         agg_field = self._agg_field(idx, aggregate) if aggregate is not None else None
-        agg_slices = (
-            self._bsi_stacked(idx, agg_field, shards) if agg_field is not None else None
-        )
 
         fields: list[Field] = []
         row_lists: list[list[int]] = []
-        matrices = []
         for ch in call.children:
             f = self._field(idx, self._call_field_name(ch))
             fields.append(f)
@@ -802,6 +914,19 @@ class Executor:
             if rlimit is not None:
                 rows = rows[:rlimit]
             row_lists.append(rows)
+
+        if host:
+            # one engine, same spec: identical row universes and emission
+            # order, so host/device results match entry for entry
+            return self.compiler.host.group_by(
+                idx, fields, row_lists, filter_call, agg_field, limit, shards
+            )
+
+        agg_slices = (
+            self._bsi_stacked(idx, agg_field, shards) if agg_field is not None else None
+        )
+        matrices = []
+        for f in fields:
             try:
                 matrices.append(
                     self.compiler.stacks.matrix(idx, f, VIEW_STANDARD, shards)[0]
@@ -813,8 +938,6 @@ class Executor:
                 matrices.append(None)
 
         if filter_call is not None:
-            if not isinstance(filter_call, Call):
-                raise ExecutionError("GroupBy filter must be a call")
             base_mask = self._filter_device(
                 idx, Call("_", {}, [filter_call]), shards
             )
@@ -1088,7 +1211,7 @@ class Executor:
 
     # ------------------------------------------------------------ writes
     def _execute_includes_column(
-        self, idx: Index, call: Call, shards: list[int]
+        self, idx: Index, call: Call, shards: list[int], host: bool = False
     ) -> bool:
         """IncludesColumn(bitmap, column=N) → bool (reference:
         executor.go executeIncludesColumnCall). Only the column's own
@@ -1104,8 +1227,10 @@ class Executor:
         shard = col_id // SHARD_WIDTH
         if shard not in shards:
             return False
-        words = self._bitmap_words(idx, call.children[0], [shard])[0]
         offset = col_id % SHARD_WIDTH
+        if host:
+            return self.compiler.host.includes_column(idx, call, shard, offset)
+        words = self._bitmap_words(idx, call.children[0], [shard])[0]
         return bool((int(words[offset // 32]) >> (offset % 32)) & 1)
 
     def _execute_write(self, idx: Index, call: Call) -> Any:

@@ -1056,6 +1056,10 @@ class Cluster:
         for node_id, node_shards in by_node.items():
             t0 = time.perf_counter()
             if node_id == self.me.id:
+                # this node serves its own shard group — counts toward
+                # the per-node replica read spread (see _h_query)
+                if stats is not None:
+                    stats.count("queries_served", tags={"path": "local"})
                 with GLOBAL_TRACER.span(
                     "cluster.local", node=node_id, shards=len(node_shards)
                 ):
@@ -2242,6 +2246,11 @@ class Cluster:
             raise ShardUnavailableError(
                 "device probe in progress on this node; retry"
             )
+        # per-node served-query counter (VERDICT #6): every read leg THIS
+        # node executes — whether taken from a coordinator (here) or
+        # served locally (the _fanout local branch) — counts once, so
+        # the cluster-wide distribution shows the replica read spread
+        self.server.stats.count("queries_served", tags={"path": "remote"})
         results = self.server.api.executor.execute(
             body["index"], body["query"], shards=body.get("shards")
         )

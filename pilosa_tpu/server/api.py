@@ -86,6 +86,7 @@ class API:
         stats=None,
         mesh_ctx=None,
         max_writes: int = 5000,
+        router=None,
     ):
         self.holder = holder
         self.cluster = cluster  # None ⇒ single-node
@@ -102,14 +103,23 @@ class API:
             mesh_ctx = MeshContext.auto()
         self.mesh_ctx = mesh_ctx
         self.stats = stats
-        self.executor = Executor(holder, mesh_ctx=mesh_ctx, stats=stats)
+        self.executor = Executor(
+            holder, mesh_ctx=mesh_ctx, stats=stats, router=router
+        )
         self.diagnostics = None  # set by Server.open
 
     def attach_mesh(self, mesh_ctx) -> None:
         """Late mesh attachment (Server.open does this after the HTTP
-        listener is up so backend init never blocks the bind)."""
+        listener is up so backend init never blocks the bind). The query
+        router carries over: its calibration (measured dispatch/readback
+        EWMAs) must survive the executor swap."""
         self.mesh_ctx = mesh_ctx
-        self.executor = Executor(self.holder, mesh_ctx=mesh_ctx, stats=self.stats)
+        self.executor = Executor(
+            self.holder,
+            mesh_ctx=mesh_ctx,
+            stats=self.stats,
+            router=self.executor.router,
+        )
 
     # ------------------------------------------------------------- schema
     def create_index(self, name: str, options: dict | None = None) -> Index:
@@ -195,6 +205,10 @@ class API:
 
         calls = parse(pql) if isinstance(pql, str) else pql
         self.check_write_limit(self.count_query_writes(calls), "query")
+        if self.stats is not None and self.cluster is None:
+            # single-node served-query counter; clustered serving counts
+            # per fan-out leg in parallel/cluster.py instead
+            self.stats.count("queries_served", tags={"path": "local"})
         results = self.executor.execute(index, calls, shards=shards)
         return self.build_response(results)
 

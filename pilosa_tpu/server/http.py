@@ -453,6 +453,9 @@ class Handler(BaseHTTPRequestHandler):
         # device-cache effectiveness counters (tests assert the write
         # path stays incremental; operators read them here)
         out["stackCache"] = self.api.executor.compiler.stacks.stats_snapshot()
+        # live cost-router calibration: mode, crossover, and the EWMAs
+        # behind every host/device decision (docs/query-routing.md)
+        out["queryRouting"] = self.api.executor.router.snapshot()
         self._json(out)
 
     def h_debug_traces(self) -> None:

@@ -41,6 +41,19 @@ class Server:
             else None
         )
         self.cluster = None
+        # per-call host/device cost router (docs/query-routing.md),
+        # seeded from config; the SAME router instance survives the
+        # late mesh attach so its calibration carries over
+        from pilosa_tpu.executor.router import QueryRouter
+
+        router = QueryRouter(
+            mode=self.config.route_mode,
+            stats=self.stats,
+            dispatch_seed_s=self.config.route_dispatch_ms / 1e3,
+            readback_seed_s=self.config.route_readback_ms / 1e3,
+            device_wps=self.config.route_device_words_per_s,
+            crossover_words=self.config.route_crossover_words,
+        )
         # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
         # backend (seconds, or worse on a wedged transport) — that must
         # not block Server() construction; open() attaches the mesh AFTER
@@ -50,6 +63,7 @@ class Server:
             stats=self.stats,
             mesh_ctx=None,
             max_writes=self.config.max_writes_per_request,
+            router=router,
         )
         self.http: HTTPServer | None = None
         self.diagnostics = None
@@ -142,7 +156,7 @@ class Server:
         self.diagnostics.open()
 
     @staticmethod
-    def _probe_device_backend(timeout_s: float) -> bool:
+    def _probe_device_backend(timeout_s: float, ttl_s: float = 0.0) -> bool:
         """Prove the backend this process will use initializes, in a
         FRESH subprocess (a wedged device transport hangs init forever,
         and a hang inside THIS process would poison every later jax
@@ -159,7 +173,19 @@ class Server:
 
         import jax
 
-        pin = jax.config.jax_platforms
+        from pilosa_tpu.utils import probecache
+
+        pin = jax.config.jax_platforms or ""
+        cached = probecache.load(ttl_s, pin)
+        if cached is not None and not cached["ok"]:
+            # a persisted NEGATIVE verdict within its TTL answers in
+            # <1 s — a known-wedged transport must not cost a fresh
+            # 300 s probe on every boot (VERDICT #3b). A positive
+            # verdict is never trusted across boots: the transport can
+            # wedge between them, and skipping the probe would recreate
+            # the unwatched first-jax-call hang this probe prevents.
+            _DEVICE_PROBE_OK = False
+            return False
         body = (
             f"import jax; jax.config.update('jax_platforms', {pin!r}); "
             "jax.devices()"
@@ -180,6 +206,7 @@ class Server:
             # here would skip the pin and recreate the indefinite
             # first-jax-call hang this probe exists to prevent.
             _DEVICE_PROBE_OK = False
+        probecache.store(_DEVICE_PROBE_OK, pin)
         return _DEVICE_PROBE_OK
 
     def _attach_mesh_when_ready(self) -> None:
@@ -191,7 +218,9 @@ class Server:
     def _attach_mesh_inner(self) -> None:
         try:
             timeout_s = self.config.device_init_timeout
-            if timeout_s > 0 and not self._probe_device_backend(timeout_s):
+            if timeout_s > 0 and not self._probe_device_backend(
+                timeout_s, self.config.device_probe_ttl
+            ):
                 # the accelerator cannot be trusted to init: pin THIS
                 # process to the CPU backend before any jax call, or the
                 # first query would hang indefinitely inside backend
@@ -200,11 +229,15 @@ class Server:
                 import jax
 
                 jax.config.update("jax_platforms", "cpu")
+                # degraded engine: every read runs on the vectorized
+                # host fast path — a CPU-pinned process must not pay
+                # jax dispatch per query (an explicit route-mode wins)
+                self.api.executor.router.pin_host()
                 self.logger.log(
                     "accelerator backend failed to initialize within "
                     f"{timeout_s:.0f}s — pinning this process to the CPU "
-                    "backend (queries serve on host; restart to retry "
-                    "the device)"
+                    "backend (queries serve on the host fast path; "
+                    "restart to retry the device)"
                 )
             if not self.config.mesh_enabled:
                 return  # probe/pin decided; nothing to attach

@@ -59,6 +59,23 @@ class Config:
     coordinator_address: str = ""
     num_processes: int = 0  # 0 = let jax.distributed infer
     process_id: int = -1  # -1 = let jax.distributed infer
+    # query routing (docs/query-routing.md): per-call host/device
+    # routing by a calibrated cost model. "auto" compares estimated work
+    # against the online crossover; "host"/"device" pin every read to
+    # one engine (the server also pins "host" when the device probe
+    # fails — the degraded engine must not pay device dispatch).
+    route_mode: str = "auto"  # auto | host | device
+    # >0 pins the crossover (words of packed-bitmap work below which a
+    # read runs on the host); 0 derives it from the calibrated model
+    route_crossover_words: float = 0.0
+    # cost-model seeds, refined online by EWMAs over measured calls
+    route_dispatch_ms: float = 1.0  # device dispatch overhead seed
+    route_readback_ms: float = 2.0  # device→host readback latency seed
+    route_device_words_per_s: float = 25e9  # device scan roofline
+    # seconds a persisted device-probe verdict stays valid: within the
+    # TTL the next boot (or bench run) reuses it instead of paying the
+    # full device-init-timeout probe against a known-wedged transport
+    device_probe_ttl: float = 900.0
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -155,6 +172,9 @@ def config_template() -> str:
         "mesh-words-axis = 1\n"
         "device-init-timeout = 300.0\n"
         "query-gate-wait = 60.0\n"
+        'route-mode = "auto"\n'
+        "route-crossover-words = 0.0\n"
+        "device-probe-ttl = 900.0\n"
         'metric-service = "prometheus"\n'
         'tls-certificate = ""\n'
         'tls-key = ""\n'

@@ -29,6 +29,27 @@ def _log_buckets() -> tuple[float, ...]:
 DEFAULT_BUCKETS = _log_buckets()
 
 
+class Ewma:
+    """Exponentially weighted moving average — the calibration primitive
+    behind the query router's online crossover (executor/router.py): the
+    first observation seeds the value, later ones fold in with weight
+    ``alpha``.  Thread-safe the cheap way: ``update`` races lose an
+    observation at worst, never corrupt the float."""
+
+    __slots__ = ("alpha", "value")
+
+    def __init__(self, alpha: float = 0.3, value: float | None = None):
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self.value = value
+
+    def update(self, x: float) -> float:
+        v = self.value
+        self.value = x if v is None else v + self.alpha * (x - v)
+        return self.value
+
+
 class Histogram:
     """Log-bucketed latency histogram with percentile snapshots and
     Prometheus ``_bucket``/``_sum``/``_count`` exposition (reference:

@@ -270,12 +270,22 @@ class QueryProfile:
         self.fanout: list[dict] = []  # per-node shard-group entries
         self._last_rpc_bytes = 0
 
-    def add_call(self, call: str, seconds: float, shards: list[int] | None) -> None:
+    def add_call(
+        self,
+        call: str,
+        seconds: float,
+        shards: list[int] | None,
+        route: str | None = None,
+    ) -> None:
         # shards is stored by REFERENCE, not copied: the collector runs
         # on every query (the long-query log mines it), so a thousands-
         # of-shards index must not pay a per-call list copy; callers
         # pass lists they do not mutate afterwards
         entry: dict = {"call": call, "seconds": seconds}
+        if route is not None:
+            # which engine the cost router picked (host | device) — the
+            # ?profile=true surface for the routing decision
+            entry["route"] = route
         if shards is not None:
             entry["shards"] = shards
         self.calls.append(entry)

@@ -33,6 +33,12 @@ def pytest_configure(config):
         "width20: production shard-width e2e suite; launch as "
         "PILOSA_TPU_SHARD_WIDTH_EXP=20 pytest -m width20 tests/test_width20.py",
     )
+    config.addinivalue_line(
+        "markers",
+        "routing: cost-based host/device query-routing suite "
+        "(tests/test_routing.py; runs in tier-1 — the marker exists so "
+        "`pytest -m routing` scopes to it)",
+    )
 
 
 @pytest.fixture

@@ -143,11 +143,16 @@ def test_debug_vars_exposes_stack_cache_counters(srv):
     srv.api.create_index("sv", {})
     srv.api.create_field("sv", "f", {})
     call(srv, "POST", "/index/sv/query", b"Set(1, f=1)")
+    # the cost router serves a query this small on the host path; the
+    # DEVICE stack-cache counters under test need a device-routed query
+    srv.api.executor.router.mode = "device"
     call(srv, "POST", "/index/sv/query", b"Count(Row(f=1))")
     v = call(srv, "GET", "/debug/vars")
     sc = v["stackCache"]
     assert sc["fullRestacks"] >= 1
     assert set(sc) >= {"deltaUpdates", "deltaRowsUploaded", "hotRowUploads", "entries"}
+    # the routing snapshot rides along (docs/query-routing.md)
+    assert v["queryRouting"]["mode"] == "device"
 
 
 def test_statsd_emission(tmp_path):

@@ -265,6 +265,10 @@ def test_query_profile_schema(srv):
     call(srv, "POST", "/index/i/query", b"Set(1, f=1) Set(3, f=2)")
     plain = call(srv, "POST", "/index/i/query", b"Count(Row(f=1))")
     assert "profile" not in plain and plain["results"] == [1]
+    # pin the device engine: this test asserts the DEVICE profile shape
+    # (the _readback wave); a query this small would otherwise be
+    # host-routed and pay no readback at all (docs/query-routing.md)
+    srv.api.executor.router.mode = "device"
     r = call(srv, "POST", "/index/i/query?profile=true", b"Count(Row(f=1))")
     assert r["results"] == [1]
     p = r["profile"]
@@ -274,10 +278,20 @@ def test_query_profile_schema(srv):
     counts = [e for e in p["calls"] if e["call"] == "Count"]
     assert counts and counts[0]["seconds"] >= 0
     assert counts[0]["shards"] == [0]
+    assert counts[0]["route"] == "device"  # the router's pick, surfaced
     # the deferred-readback wave is accounted separately
     assert any(e["call"] == "_readback" for e in p["calls"])
     # single-node: no fan-out legs
     assert p["fanout"] == []
+
+    # host-routed profile: same shape, route=host, and NO readback wave
+    srv.api.executor.router.mode = "host"
+    r = call(srv, "POST", "/index/i/query?profile=true", b"Count(Row(f=1))")
+    assert r["results"] == [1]
+    hcalls = r["profile"]["calls"]
+    assert [e for e in hcalls if e["call"] == "Count"][0]["route"] == "host"
+    assert not any(e["call"] == "_readback" for e in hcalls)
+    srv.api.executor.router.mode = "auto"
 
 
 def test_trace_spans_have_identity(srv):

@@ -43,7 +43,7 @@ def _high_card_holder(n_rows=100_000, n_shards=2, seed=0):
 
 def test_over_budget_raises_explicitly(tight_budget):
     h, f, *_ = _high_card_holder(n_rows=5000, n_shards=2)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     with pytest.raises(StackOverBudget) as err:
         e.compiler.stacks.matrix(
             h.index("hc"), f, "standard", [0, 1]
@@ -55,7 +55,7 @@ def test_row_count_via_hot_path(tight_budget):
     h, f, rows, cols, extra_rows, extra_cols = _high_card_holder(
         n_rows=5000, n_shards=2
     )
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     stacks = e.compiler.stacks
     # Count on individual high rows — exact, via hot slots
     for rid in (4999, 1234, 7):
@@ -71,19 +71,19 @@ def test_row_count_via_hot_path(tight_budget):
 
 def test_hot_rows_track_writes(tight_budget):
     h, f, *_ = _high_card_holder(n_rows=5000, n_shards=2)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     base = e.execute("hc", "Count(Row(f=42))")[0]
     assert e.execute("hc", "Set(99, f=42)")[0] in (True, False)
     assert e.execute("hc", "Count(Row(f=42))")[0] >= base
     # composite call across hot rows
     got = e.execute("hc", "Count(Union(Row(f=42), Row(f=43)))")[0]
-    fresh = Executor(h).execute("hc", "Count(Union(Row(f=42), Row(f=43)))")[0]
+    fresh = Executor(h, route_mode="device").execute("hc", "Count(Union(Row(f=42), Row(f=43)))")[0]
     assert got == fresh
 
 
 def test_topn_chunked_exact_100k_rows(tight_budget):
     h, f, rows, cols, extra_rows, extra_cols = _high_card_holder(n_rows=100_000)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     res = e.execute("hc", "TopN(f, n=5)")[0]
     counts: dict[int, int] = {}
     for r in np.concatenate([rows, extra_rows]).tolist():
@@ -98,7 +98,7 @@ def test_union_wider_than_hot_capacity_errors(tight_budget, monkeypatch):
     evicted slot."""
     monkeypatch.setattr(StackCache, "MAX_DELTA_ROWS", 0)  # isolate hot path
     h, f, *_ = _high_card_holder(n_rows=5000, n_shards=2)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     cap = e.compiler.stacks.hot_capacity(2)
     q = "Count(Union(" + ", ".join(f"Row(f={r})" for r in range(cap + 1)) + "))"
     with pytest.raises(ExecutionError) as err:
@@ -107,13 +107,13 @@ def test_union_wider_than_hot_capacity_errors(tight_budget, monkeypatch):
     # at capacity it works and is exact
     q_ok = "Count(Union(" + ", ".join(f"Row(f={r})" for r in range(20)) + "))"
     got = e.execute("hc", q_ok)[0]
-    fresh = Executor(h).execute("hc", q_ok)[0]
+    fresh = Executor(h, route_mode="device").execute("hc", q_ok)[0]
     assert got == fresh
 
 
 def test_hot_entries_lru_bounded(tight_budget):
     h, f, *_ = _high_card_holder(n_rows=5000, n_shards=2)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     stacks = e.compiler.stacks
     # distinct shard subsets create distinct hot entries; the LRU cap
     # bounds them (each entry is budget-sized on a real device)
@@ -130,7 +130,7 @@ def test_groupby_over_budget_streams_exact(tight_budget):
     h, f, rows, cols, extra_rows, extra_cols = _high_card_holder(
         n_rows=5000, n_shards=2
     )
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     got = e.execute("hc", "GroupBy(Rows(f))")[0]
     counts: dict[int, int] = {}
     for r in np.concatenate([rows, extra_rows]).tolist():
@@ -160,7 +160,7 @@ def test_groupby_over_budget_nested_with_filter(tight_budget):
     f.import_bulk(rows, cols)
     g.import_bulk((cols % 2).astype(np.uint64), cols)
     idx.mark_columns_exist(cols)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     res = e.execute("hc", "GroupBy(Rows(big), Rows(small), limit=40)")[0]
     assert res, "no groups returned"
     for entry in res:
@@ -206,7 +206,7 @@ def test_aggregate_budget_evicts_lru_stack(monkeypatch):
         )
     one_stack = 8 * WORDS_PER_SHARD * 4  # [R_pad=8, S=1, W] uint32
     monkeypatch.setattr(C.StackCache, "STACK_BYTES_BUDGET", int(one_stack * 1.5))
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     stacks = e.compiler.stacks
     stacks.matrix(idx, fa, "standard", [0])
     assert stacks.resident_bytes == one_stack

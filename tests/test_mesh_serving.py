@@ -164,7 +164,9 @@ def test_device_probe_failure_pins_cpu_and_serves(tmp_path, monkeypatch):
     import pilosa_tpu.server.server as srvmod
 
     monkeypatch.setattr(
-        srvmod.Server, "_probe_device_backend", staticmethod(lambda t: False)
+        srvmod.Server,
+        "_probe_device_backend",
+        staticmethod(lambda t, ttl=0.0: False),
     )
     s = Server(
         Config(

@@ -132,11 +132,56 @@ def test_stacks_sharded_over_both_axes(rig):
     )
 
 
-@pytest.mark.parametrize("n_devices,words_axis", [(16, 4), (32, 8)])
+def test_qps_vs_device_count_curve(capsys):
+    """QPS-vs-device-count curve over the virtual platform (ISSUE 2
+    satellite): the same executor Count shape on 1/2/4/8-device meshes.
+    On virtual CPU devices the absolute numbers are meaningless — what
+    the curve proves is that every mesh width compiles, executes
+    EXACTLY, and emits a machine-readable scaling record (the real-chip
+    analogue is read off the MULTICHIP artifact)."""
+    import json
+    import time
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual platform")
+    rng = np.random.default_rng(11)
+    n_shards = 8
+    n = 4000
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, n).astype(np.uint64)
+    rows = rng.integers(0, 4, n).astype(np.uint64)
+    expect = len({c for r, c in zip(rows.tolist(), cols.tolist()) if r in (1, 2)})
+
+    curve = []
+    for n_dev in (1, 2, 4, 8):
+        ctx = MeshContext(make_mesh(jax.devices()[:n_dev], words_axis=1))
+        h = Holder(None)
+        idx = h.create_index("b")
+        f = idx.create_field("f")
+        f.import_bulk(rows, cols)
+        e = Executor(h, mesh_ctx=ctx, route_mode="device")
+        pql = "Count(Union(Row(f=1), Row(f=2)))"
+        got = e.execute("b", pql)[0]
+        assert got == expect, (n_dev, got, expect)
+        iters = 20
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            e.execute("b", pql)
+        qps = iters / (time.perf_counter() - t0)
+        curve.append({"devices": n_dev, "qps": round(qps, 1)})
+    assert all(pt["qps"] > 0 for pt in curve)
+    # machine-readable record for the smoke artifact (driver greps stdout)
+    with capsys.disabled():
+        print(json.dumps({"metric": "spmd_qps_vs_devices", "curve": curve}),
+              flush=True)
+
+
+@pytest.mark.parametrize("n_devices,words_axis", [(16, 4), (32, 8), (64, 8)])
 def test_dryrun_multichip_pod_shape(n_devices, words_axis):
-    """VERDICT r4 next #9: the multi-chip dry run must stay green at
-    pod-shaped 16- and 32-device virtual meshes (words_axis 4 and 8 —
-    words is the minor/ICI axis, shards the major/DCN axis), including
+    """VERDICT r4 next #9 + ISSUE 2 satellite: the multi-chip dry run
+    must stay green at pod-shaped 16-, 32- and 64-device virtual meshes
+    (words_axis 4 and 8 — words is the minor/ICI axis, shards the
+    major/DCN axis; at 64 devices the grid is 8×8 with a multihost-style
+    contiguous-words-row assertion inside dryrun_multichip), including
     the scaled-down BASELINE config-5 Tanimoto search. Runs in a
     subprocess because the in-process backend is pinned to 8 virtual
     devices by conftest."""

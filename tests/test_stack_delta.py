@@ -33,7 +33,7 @@ def _setup(n_shards=4, rows=6, seed=0):
 
 def test_interleaved_set_query_uses_delta_path():
     h, idx, f, rids, cols = _setup()
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     stacks = e.compiler.stacks
 
     base = e.execute("d", "Count(Row(f=1))")[0]
@@ -59,7 +59,7 @@ def test_interleaved_set_query_uses_delta_path():
 
 def test_delta_path_matches_fresh_executor():
     h, idx, f, rids, cols = _setup(seed=3)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     e.execute("d", "Count(Row(f=0))")
     rng = np.random.default_rng(7)
     for _ in range(25):
@@ -70,7 +70,7 @@ def test_delta_path_matches_fresh_executor():
         else:
             e.execute("d", f"Clear({col}, f={row})")
     # incremental state must equal a from-scratch evaluation
-    fresh = Executor(h)
+    fresh = Executor(h, route_mode="device")
     for row in range(6):
         q = f"Count(Row(f={row}))"
         assert e.execute("d", q) == fresh.execute("d", q)
@@ -80,7 +80,7 @@ def test_delta_path_matches_fresh_executor():
 
 def test_bulk_import_falls_back_to_restack():
     h, idx, f, rids, cols = _setup(seed=5)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     e.execute("d", "Count(Row(f=1))")
     before = e.compiler.stacks.full_restacks
     # dirty MORE distinct rows than the delta budget allows — the cache
@@ -91,7 +91,7 @@ def test_bulk_import_falls_back_to_restack():
     new_rows = np.arange(1500, dtype=np.uint64) + 10
     f.import_bulk(new_rows, new_cols)
     got = e.execute("d", "Count(Row(f=1))")[0]
-    expect = Executor(h).execute("d", "Count(Row(f=1))")[0]
+    expect = Executor(h, route_mode="device").execute("d", "Count(Row(f=1))")[0]
     assert got == expect
     assert e.compiler.stacks.full_restacks > before
 
@@ -128,11 +128,11 @@ def test_delta_keeps_namedsharding_on_mesh():
 
 def test_row_growth_forces_restack_and_stays_correct():
     h, idx, f, rids, cols = _setup(rows=8, seed=9)
-    e = Executor(h)
+    e = Executor(h, route_mode="device")
     e.execute("d", "Count(Row(f=1))")
     # write to a row far beyond the padded height
     e.execute("d", f"Set(5, f=100)")
     assert e.execute("d", "Count(Row(f=100))")[0] == 1
-    assert e.execute("d", "Count(Row(f=1))") == Executor(h).execute(
+    assert e.execute("d", "Count(Row(f=1))") == Executor(h, route_mode="device").execute(
         "d", "Count(Row(f=1))"
     )
