@@ -1,0 +1,16 @@
+.PHONY: check fix test analyze
+
+# the same gate CI runs: repo analyzer, then ruff/mypy when installed
+check:
+	python tools/check.py
+
+# apply the analyzer's mechanical autofixes (with-locks, monotonic)
+fix:
+	python tools/check.py --fix
+
+analyze:
+	python -m tools.analysis pilosa_tpu
+
+# tier-1 test suite (see ROADMAP.md for the exact CI invocation)
+test:
+	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'

@@ -219,7 +219,8 @@ def cmd_check(args) -> int:
             bm, consumed = roaring.deserialize(data)
             n_ops = roaring.replay_ops(bm, data[consumed:])
             print(f"{path}: OK ({bm.count()} bits, {n_ops} ops replayed)")
-        except Exception as e:
+        except Exception as e:  # pilosa: allow(broad-except) — the
+            # check command's JOB is classifying any failure as CORRUPT
             ok = False
             print(f"{path}: CORRUPT — {e}")
     return 0 if ok else 1

@@ -163,7 +163,9 @@ class AttrStore:
     def _prune_tombstones(self) -> None:
         """Drop tombstones past TTL (and then-empty IDs) so churny
         delete workloads don't grow the store without bound."""
-        horizon = time.time() - TOMBSTONE_TTL_SECONDS
+        # wall clock on purpose: tombstone timestamps are persisted and
+        # replicated — node-local monotonic time means nothing to peers
+        horizon = time.time() - TOMBSTONE_TTL_SECONDS  # pilosa: allow(wall-clock)
         for id_ in list(self._cells):
             cells = self._cells[id_]
             for k in [

@@ -24,7 +24,7 @@ from __future__ import annotations
 import os
 import time
 from datetime import datetime
-from typing import Any
+from typing import Any, Callable
 
 import numpy as np
 
@@ -127,12 +127,12 @@ class _Pending:
 
     __slots__ = ("arrays", "finish", "value")
 
-    def __init__(self, arrays, finish):
+    def __init__(self, arrays: list, finish: "Callable[[list], Any]") -> None:
         self.arrays = list(arrays)
         self.finish = finish
         self.value = None
 
-    def resolve_now(self):
+    def resolve_now(self) -> Any:
         self.value = self.finish([np.asarray(a) for a in self.arrays])
         return self.value
 

@@ -28,23 +28,23 @@ import jax.numpy as jnp
 BITS_PER_WORD = 32
 
 
-def w_and(a, b):
+def w_and(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.bitwise_and(a, b)
 
 
-def w_or(a, b):
+def w_or(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.bitwise_or(a, b)
 
 
-def w_xor(a, b):
+def w_xor(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.bitwise_xor(a, b)
 
 
-def w_andnot(a, b):
+def w_andnot(a: jax.Array, b: jax.Array) -> jax.Array:
     return jnp.bitwise_and(a, jnp.bitwise_not(b))
 
 
-def w_not(a):
+def w_not(a: jax.Array) -> jax.Array:
     """Complement. Caller must mask to the valid column range afterwards
     (Not() in PQL is bounded by the index's existence row)."""
     return jnp.bitwise_not(a)
@@ -109,7 +109,7 @@ def matrix_filter_counts(matrix, filt) -> jax.Array:
     return popcount_rows(jnp.bitwise_and(matrix, filt[..., None, :]))
 
 
-def shift_words(words, n: int):
+def shift_words(words: jax.Array, n: int) -> jax.Array:
     """Shift set-bit positions up by static ``n`` (PQL Shift): bit p → p+n,
     bits shifted past the end of the word vector fall off.
 
@@ -135,7 +135,7 @@ def shift_words(words, n: int):
     return w
 
 
-def column_mask(width: int, n_words: int):
+def column_mask(width: int, n_words: int) -> jax.Array:
     """uint32[n_words] with the low ``width`` bits set — masks a shard's
     valid column range (the last shard of an index may be partial)."""
     idx = jnp.arange(n_words, dtype=jnp.int32)

@@ -19,6 +19,7 @@ Python loops below unroll into straight-line XLA ops.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -34,7 +35,7 @@ OFFSET_ROW = 2
 _ONES = np.uint32(0xFFFFFFFF)
 
 
-def _magnitude_cmp(mag, c_abs: int):
+def _magnitude_cmp(mag: jax.Array, c_abs: int) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-column compare of magnitude slices vs constant |c|.
 
     ``mag``: uint32[depth, W], LSB-first. Returns (eq, lt, gt) word masks.
@@ -56,7 +57,7 @@ def _magnitude_cmp(mag, c_abs: int):
     return eq, lt, gt
 
 
-def compare(slices, op: str, value: int):
+def compare(slices: jax.Array, op: str, value: int) -> jax.Array:
     """Columns whose stored value ⟨op⟩ ``value`` → uint32[W] mask.
 
     ``op`` ∈ {"==", "!=", "<", "<=", ">", ">="}. The caller intersects the
@@ -104,12 +105,12 @@ def compare(slices, op: str, value: int):
     raise ValueError(f"bad BSI comparison op {op!r}")
 
 
-def between(slices, lo: int, hi: int):
+def between(slices: jax.Array, lo: int, hi: int) -> jax.Array:
     """Columns with lo <= value <= hi (PQL Range/between) → uint32[W]."""
     return compare(slices, ">=", lo) & compare(slices, "<=", hi)
 
 
-def sum_counts(slices, filt):
+def sum_counts(slices: jax.Array, filt: jax.Array) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Per-magnitude-bit signed counts for Sum.
 
     Returns (pos_counts int32[depth], neg_counts int32[depth], n int64):
@@ -137,7 +138,7 @@ def weigh_sum(pos_counts, neg_counts) -> int:
     return total
 
 
-def sum_device(slices, filt):
+def sum_device(slices: jax.Array, filt: jax.Array) -> tuple[jax.Array, jax.Array]:
     """All-device Sum → (sum int64, count int64). Used inside sharded
     programs where the result participates in a psum; needs x64 enabled
     (pilosa_tpu.ops turns it on at import)."""
@@ -148,7 +149,7 @@ def sum_device(slices, filt):
     return jnp.sum(diff * weights), n
 
 
-def min_max(slices, filt, want_max: bool):
+def min_max(slices: jax.Array, filt: jax.Array, want_max: bool) -> tuple[jax.Array, jax.Array]:
     """(value int64, count int64) of the min/max stored value among
     filtered, existing columns. count==0 ⇒ no value (result undefined).
 

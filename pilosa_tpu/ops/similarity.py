@@ -22,7 +22,9 @@ import jax.numpy as jnp
 from pilosa_tpu.ops.bitwise import matrix_filter_counts, popcount_rows
 
 
-def tanimoto_search(matrix, query, k: int = 10, threshold: float = 0.0):
+def tanimoto_search(
+    matrix: jax.Array, query: jax.Array, k: int = 10, threshold: float = 0.0
+) -> tuple[jax.Array, jax.Array]:
     """Top-k rows of ``matrix`` (uint32[R, W]) by Tanimoto similarity to
     ``query`` (uint32[W]) → (scores f32[k], row_ids int32[k]).
 
@@ -39,14 +41,14 @@ def tanimoto_search(matrix, query, k: int = 10, threshold: float = 0.0):
     return vals, ids.astype(jnp.int32)
 
 
-def _unpack_bits_bf16(packed):
+def _unpack_bits_bf16(packed: jax.Array) -> jax.Array:
     """uint32[..., W] → bf16[..., W*32] of {0,1} (LSB-first within word)."""
     shifts = jnp.arange(32, dtype=jnp.uint32)
     bits = (packed[..., :, None] >> shifts) & jnp.uint32(1)
     return bits.reshape(*packed.shape[:-1], -1).astype(jnp.bfloat16)
 
 
-def pairwise_intersections(a_packed, b_packed):
+def pairwise_intersections(a_packed: jax.Array, b_packed: jax.Array) -> jax.Array:
     """All-pairs intersection counts via one MXU matmul.
 
     a: uint32[N, W], b: uint32[M, W] → f32[N, M] = |a_i ∩ b_j|.
@@ -58,7 +60,7 @@ def pairwise_intersections(a_packed, b_packed):
     )
 
 
-def tanimoto_matrix(a_packed, b_packed):
+def tanimoto_matrix(a_packed: jax.Array, b_packed: jax.Array) -> jax.Array:
     """All-pairs Tanimoto: f32[N, M]."""
     inter = pairwise_intersections(a_packed, b_packed)
     a_pop = popcount_rows(a_packed).astype(jnp.float32)
@@ -67,7 +69,7 @@ def tanimoto_matrix(a_packed, b_packed):
     return jnp.where(union > 0, inter / union, 0.0)
 
 
-def cosine_matrix(a_packed, b_packed):
+def cosine_matrix(a_packed: jax.Array, b_packed: jax.Array) -> jax.Array:
     """All-pairs cosine similarity of bit vectors: f32[N, M] =
     |a∩b| / sqrt(|a|·|b|)."""
     inter = pairwise_intersections(a_packed, b_packed)

@@ -16,7 +16,7 @@ import jax.numpy as jnp
 from pilosa_tpu.ops.bitwise import matrix_filter_counts
 
 
-def top_rows(matrix, filt, k: int):
+def top_rows(matrix: jax.Array, filt: jax.Array, k: int) -> tuple[jax.Array, jax.Array]:
     """(counts int32[k], row_ids int32[k]) of the k largest filtered row
     counts in one fragment. Rows with zero count still appear if k exceeds
     the number of nonzero rows; callers drop zeros."""
@@ -26,7 +26,9 @@ def top_rows(matrix, filt, k: int):
     return vals, idx.astype(jnp.int32)
 
 
-def candidate_counts(matrix, row_ids, filt):
+def candidate_counts(
+    matrix: jax.Array, row_ids: jax.Array, filt: jax.Array
+) -> jax.Array:
     """Phase-2 exact recount: gather candidate rows and popcount under the
     filter. ``row_ids`` int32[C] may contain out-of-range ids (rows another
     shard has but this one doesn't); they gather a zero row.

@@ -27,7 +27,7 @@ from typing import Any
 
 import numpy as np
 
-from pilosa_tpu.executor import RowResult
+from pilosa_tpu.executor import ExecutionError, RowResult
 from pilosa_tpu.executor.executor import WRITE_CALLS, apply_options, unwrap_options
 from pilosa_tpu.parallel.resultwire import (  # noqa: F401 (re-exported)
     decode_result,
@@ -1039,6 +1039,32 @@ class Cluster:
                     apply_options(idx, wrapper, result)
         return result
 
+    def _timed_query_node(
+        self,
+        span_name: str,
+        node: "Node",
+        index: str,
+        pql: str,
+        shards: list[int] | None,
+    ) -> tuple[list[Any], float]:
+        """One fan-out RPC leg with the observability contract applied
+        in ONE place: a tracing span + the ``fanout_rpc_seconds``
+        histogram (the analyzer's observability rule keys on exactly
+        this pairing around ``client.query_node``).  Returns (decoded
+        results, elapsed seconds); a failed leg raises before the
+        histogram records, same as before extraction."""
+        t0 = time.perf_counter()
+        with GLOBAL_TRACER.span(
+            span_name, node=node.id, shards=len(shards) if shards else 0
+        ):
+            result = self.client.query_node(node.uri, index, pql, shards)
+        elapsed = time.perf_counter() - t0
+        if self.server.stats is not None:
+            self.server.stats.timing(
+                "fanout_rpc_seconds", elapsed, tags={"node": node.id}
+            )
+        return result, elapsed
+
     def _fanout(
         self,
         index: str,
@@ -1077,31 +1103,27 @@ class Cluster:
                         0,
                     )
                 continue
-            with GLOBAL_TRACER.span(
-                "cluster.fanout", node=node_id, shards=len(node_shards)
-            ):
-                try:
-                    remote = self.client.query_node(
-                        node_by_id[node_id].uri, index, call.to_pql(), node_shards
-                    )
-                except PeerError as e:
-                    # a probe-gate 503 means the peer is ALIVE and serving
-                    # (its heartbeats succeed) but its device verdict is
-                    # pending — marking it dead would route reads around a
-                    # live sole holder on every client retry for the whole
-                    # probe window. Any other failure: heartbeat state was
-                    # stale — mark dead NOW so the next read reroutes to a
-                    # replica, and fail this one loudly either way.
-                    if "device probe in progress" not in str(e):
-                        node_by_id[node_id].alive = False
-                    raise ShardUnavailableError(
-                        f"shard owner {node_id} failed mid-query: {e}"
-                    ) from e
-            elapsed = time.perf_counter() - t0
-            if stats is not None:
-                stats.timing(
-                    "fanout_rpc_seconds", elapsed, tags={"node": node_id}
+            try:
+                remote, elapsed = self._timed_query_node(
+                    "cluster.fanout",
+                    node_by_id[node_id],
+                    index,
+                    call.to_pql(),
+                    node_shards,
                 )
+            except PeerError as e:
+                # a probe-gate 503 means the peer is ALIVE and serving
+                # (its heartbeats succeed) but its device verdict is
+                # pending — marking it dead would route reads around a
+                # live sole holder on every client retry for the whole
+                # probe window. Any other failure: heartbeat state was
+                # stale — mark dead NOW so the next read reroutes to a
+                # replica, and fail this one loudly either way.
+                if "device probe in progress" not in str(e):
+                    node_by_id[node_id].alive = False
+                raise ShardUnavailableError(
+                    f"shard owner {node_id} failed mid-query: {e}"
+                ) from e
             if prof is not None:
                 prof.add_fanout(
                     call.name,
@@ -1319,7 +1341,8 @@ class Cluster:
             return
         try:
             fname = self.server.api.executor._call_field_name(call)
-        except Exception:
+        except ExecutionError:
+            # call carries no field argument — nothing to re-key
             return
         f = idx.field(fname)
         if f is None or not f.options.keys:
@@ -1418,9 +1441,14 @@ class Cluster:
                 if owner.id == self.me.id:
                     r = self.server.api.executor.execute(index, [call])[0]
                 else:
-                    r = self.client.query_node(
-                        owner.uri, index, call.to_pql(), [shard]
-                    )[0]
+                    remote, _ = self._timed_query_node(
+                        "cluster.write_fanout",
+                        owner,
+                        index,
+                        call.to_pql(),
+                        [shard],
+                    )
+                    r = remote[0]
                 took_write.append(owner.uri)
                 result = r if result is None else result
             if result is None:
@@ -1445,7 +1473,10 @@ class Cluster:
             if n.id == self.me.id:
                 r = self.server.api.executor.execute(index, [call])[0]
             else:
-                r = self.client.query_node(n.uri, index, call.to_pql(), None)[0]
+                remote, _ = self._timed_query_node(
+                    "cluster.write_fanout", n, index, call.to_pql(), None
+                )
+                r = remote[0]
             if isinstance(r, bool):
                 result = bool(result) | r
             else:

@@ -36,7 +36,7 @@ class Container:
 
     __slots__ = ("type", "data")
 
-    def __init__(self, ctype: int, data: np.ndarray):
+    def __init__(self, ctype: int, data: np.ndarray) -> None:
         self.type = ctype
         self.data = data
 

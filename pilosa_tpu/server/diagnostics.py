@@ -20,7 +20,10 @@ import time
 class DiagnosticsCollector:
     def __init__(self, server):
         self.server = server
-        self.start_time = time.time()
+        self.start_time = time.time()  # boot wall timestamp (started_at)
+        # uptime measures on the monotonic clock: wall time steps under
+        # NTP and a negative uptime has shipped in real diagnostics
+        self._start_mono = time.monotonic()
         self._timer: threading.Timer | None = None
         self._closed = False
         self.last: dict = {}
@@ -46,7 +49,8 @@ class DiagnosticsCollector:
         snap = {
             "version": __version__,
             "time": time.time(),
-            "uptime_seconds": round(time.time() - self.start_time, 1),
+            "uptime_seconds": round(time.monotonic() - self._start_mono, 1),
+            "started_at": self.start_time,
             "node_id": self.server.config.node_id,
             "num_indexes": len(holder.indexes),
             "num_fields": n_fields,
@@ -71,7 +75,9 @@ class DiagnosticsCollector:
                 import jax
 
                 self._backend_cache = jax.devices()[0].platform
-            except Exception:
+            except Exception:  # pilosa: allow(broad-except) — backend
+                # init failures are backend-specific (RuntimeError,
+                # OSError, plugin errors); diagnostics must never raise
                 self._backend_cache = "unavailable"
         return self._backend_cache
 

@@ -116,15 +116,27 @@ class Handler(BaseHTTPRequestHandler):
                 if match:
                     self.route_name = name
                     self.stats.count("http_requests", tags={"route": name})
-                    with GLOBAL_TRACER.span(f"http.{name}"):
-                        self._guarded(getattr(self, "h_" + name), *match.groups())
+                    # every route pays the same span + per-route latency
+                    # histogram here — handlers cannot opt out of either
+                    # (the observability analyzer rule pins this down)
+                    with self.stats.timer(
+                        "http_request_seconds", tags={"route": name}
+                    ):
+                        with GLOBAL_TRACER.span(f"http.{name}"):
+                            self._guarded(
+                                getattr(self, "h_" + name), *match.groups()
+                            )
                     return
-            # extra (/internal/*) routes get the same error mapping, and a
-            # span so remote data-plane work appears in the stitched trace
-            with GLOBAL_TRACER.span("http.internal", path=parsed.path):
-                handled = self._guarded(
-                    self.server.handle_extra, self, method, parsed.path
-                )
+            # extra (/internal/*) routes get the same error mapping, a
+            # span so remote data-plane work appears in the stitched
+            # trace, and the same per-route histogram (route=internal)
+            with self.stats.timer(
+                "http_request_seconds", tags={"route": "internal"}
+            ):
+                with GLOBAL_TRACER.span("http.internal", path=parsed.path):
+                    handled = self._guarded(
+                        self.server.handle_extra, self, method, parsed.path
+                    )
         if handled is False:
             self._json({"error": "not found"}, code=404)
 
@@ -140,7 +152,9 @@ class Handler(BaseHTTPRequestHandler):
             self._error(str(e), code=503)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-response
-        except Exception as e:  # internal error
+        except Exception as e:  # pilosa: allow(broad-except) — the
+            # route error chokepoint: anything a handler leaks maps to a
+            # 500 response instead of killing the connection thread
             if encoding.AVAILABLE and isinstance(e, encoding.DecodeError):
                 self._error(f"bad protobuf body: {e}", code=400)
             else:

@@ -168,14 +168,22 @@ def config_template() -> str:
         "diagnostics-interval = 3600.0\n"
         "max-writes-per-request = 5000\n"
         "long-query-time = 0.0\n"
+        'log-path = ""\n'
         "mesh-enabled = true\n"
         "mesh-words-axis = 1\n"
         "device-init-timeout = 300.0\n"
         "query-gate-wait = 60.0\n"
+        'coordinator-address = ""\n'
+        "num-processes = 0\n"
+        "process-id = -1\n"
         'route-mode = "auto"\n'
         "route-crossover-words = 0.0\n"
+        "route-dispatch-ms = 1.0\n"
+        "route-readback-ms = 2.0\n"
+        "route-device-words-per-s = 25e9\n"
         "device-probe-ttl = 900.0\n"
         'metric-service = "prometheus"\n'
+        'statsd-host = ""\n'
         'tls-certificate = ""\n'
         'tls-key = ""\n'
         "tls-skip-verify = false\n"

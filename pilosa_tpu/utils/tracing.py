@@ -55,7 +55,7 @@ def new_span_id() -> str:
 # one wall↔monotonic anchor so exported timestamps share a single
 # monotonic timeline (mixing time.time starts with perf_counter
 # durations lets child slices cross parent boundaries in trace viewers)
-_PERF_EPOCH = time.time() - time.perf_counter()
+_PERF_EPOCH = time.time() - time.perf_counter()  # pilosa: allow(wall-clock)
 
 
 class Span:

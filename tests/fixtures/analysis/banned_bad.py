@@ -1,0 +1,33 @@
+"""Seeded violations for the banned-pattern rules (bare-except,
+broad-except, mutable-default, wall-clock)."""
+
+import time
+
+
+def swallow_everything():
+    try:
+        work()
+    except:  # bare
+        pass
+
+
+def swallow_most():
+    try:
+        work()
+    except Exception:  # broad, no pragma, no re-raise
+        pass
+
+
+def shared_default(items=[]):  # mutable default
+    items.append(1)
+    return items
+
+
+def wall_clock_latency():
+    t0 = time.time()
+    work()
+    return time.time() - t0  # duration on the wall clock
+
+
+def work():
+    pass

@@ -1,0 +1,42 @@
+"""Clean twin of banned_bad.py."""
+
+import time
+
+
+def narrow_catch():
+    try:
+        work()
+    except (ValueError, OSError):
+        pass
+
+
+def cleanup_reraise():
+    try:
+        work()
+    except Exception:  # broad but re-raises: swallows nothing
+        undo()
+        raise
+
+
+def fresh_default(items=None):
+    items = [] if items is None else items
+    items.append(1)
+    return items
+
+
+def monotonic_latency():
+    t0 = time.monotonic()
+    work()
+    return time.monotonic() - t0
+
+
+def wall_timestamp():
+    return {"ts": time.time()}  # timestamps are what wall clocks are for
+
+
+def work():
+    pass
+
+
+def undo():
+    pass

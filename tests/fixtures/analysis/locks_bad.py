@@ -1,0 +1,28 @@
+"""Seeded violations for `raw-acquire` and `lock-order`."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def raw_acquire_leak():
+    lock_a.acquire()
+    do_work()  # an exception here leaks lock_a forever
+    lock_a.release()
+
+
+def ab_order():
+    with lock_a:
+        with lock_b:
+            do_work()
+
+
+def ba_order():
+    with lock_b:
+        with lock_a:  # cycle: lock_a -> lock_b -> lock_a
+            do_work()
+
+
+def do_work():
+    pass

@@ -1,0 +1,36 @@
+"""Clean twin of locks_bad.py: with-statement sugar, try/finally for
+the conditional case, one global order."""
+
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def with_sugar():
+    with lock_a:
+        do_work()
+
+
+def guarded_acquire():
+    lock_a.acquire()
+    try:
+        do_work()
+    finally:
+        lock_a.release()
+
+
+def consistent_order_1():
+    with lock_a:
+        with lock_b:
+            do_work()
+
+
+def consistent_order_2():
+    with lock_a:
+        with lock_b:  # same a -> b order everywhere: no cycle
+            do_work()
+
+
+def do_work():
+    pass

@@ -1,0 +1,27 @@
+"""Seeded violations for the `readback` rule: device syncs outside the
+sanctioned readback layer (this file is parsed, never imported)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def leaky_count(words):
+    mask = jnp.ones_like(words)
+    total = jnp.sum(words & mask)
+    return int(np.asarray(total))  # np.asarray on a tainted name
+
+
+def leaky_sync(words):
+    out = jnp.sum(words)
+    out.block_until_ready()  # unconditional sync
+    return out
+
+
+def leaky_get(words):
+    return jax.device_get(jnp.sum(words))  # device_get outside executor
+
+
+def leaky_item(words):
+    s = jnp.sum(words)
+    return s.item()  # .item() on a tainted name

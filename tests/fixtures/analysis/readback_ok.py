@@ -1,0 +1,20 @@
+"""Clean twin of readback_bad.py: device values stay on device (the
+caller's readback wave fetches them), host values coerce freely."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def deferred_count(words):
+    mask = jnp.ones_like(words)
+    return jnp.sum(words & mask)  # device value returned, not synced
+
+
+def host_math(host_words):
+    arr = np.asarray(host_words)  # numpy on a host value: fine
+    return int(arr.sum())
+
+
+def pragma_sync(words):
+    total = jnp.sum(words)
+    return int(np.asarray(total))  # pilosa: allow(readback)

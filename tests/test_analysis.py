@@ -1,0 +1,440 @@
+"""Tier-1 gate for the static-analysis suite (tools/analysis).
+
+Enforces the two acceptance invariants:
+
+- the SHIPPED tree is clean: ``python -m tools.analysis pilosa_tpu``
+  exits 0 — a PR that introduces a violation fails here;
+- the suite actually detects what it claims: every seeded-violation
+  fixture exits non-zero naming its rule, every clean twin exits 0, and
+  mutating the live tree (removing a hostpath call type, dropping a
+  route handler, adding an undocumented config knob) flips the analyzer
+  to failing.
+
+Plus unit tests for the two autofixes, including idempotence.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import shutil
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+FIXTURES = REPO / "tests" / "fixtures" / "analysis"
+
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.analysis.engine import Project, run as run_rules  # noqa: E402
+from tools.analysis.fixes import fix_monotonic, fix_with_locks  # noqa: E402
+
+
+def run_analyzer(*args: str) -> tuple[int, str]:
+    from tools.analysis.__main__ import main
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf), contextlib.redirect_stderr(buf):
+        rc = main(list(args))
+    return rc, buf.getvalue()
+
+
+# ------------------------------------------------------------- live tree
+def test_live_tree_is_clean():
+    rc, out = run_analyzer(str(REPO / "pilosa_tpu"))
+    assert rc == 0, f"analyzer must pass on the shipped tree:\n{out}"
+
+
+def test_module_entrypoint_runs():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "pilosa_tpu"],
+        cwd=REPO,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_rule_registry_complete():
+    rc, out = run_analyzer("--list-rules")
+    assert rc == 0
+    for name in (
+        "readback",
+        "raw-acquire",
+        "lock-order",
+        "parity",
+        "observability",
+        "config-drift",
+        "bare-except",
+        "broad-except",
+        "mutable-default",
+        "wall-clock",
+    ):
+        assert name in out, f"rule {name} missing from registry"
+
+
+# ---------------------------------------------------------- rule fixtures
+@pytest.mark.parametrize(
+    "fixture, rules",
+    [
+        ("readback_bad.py", ["readback"]),
+        ("locks_bad.py", ["raw-acquire", "lock-order"]),
+        (
+            "banned_bad.py",
+            ["bare-except", "broad-except", "mutable-default", "wall-clock"],
+        ),
+    ],
+)
+def test_seeded_fixture_fails(fixture, rules):
+    rc, out = run_analyzer(str(FIXTURES / fixture))
+    assert rc != 0, f"{fixture} must fail the analyzer"
+    for r in rules:
+        assert f"[{r}]" in out, f"{fixture} must trip rule {r}:\n{out}"
+
+
+@pytest.mark.parametrize(
+    "fixture", ["readback_ok.py", "locks_ok.py", "banned_ok.py"]
+)
+def test_clean_fixture_passes(fixture):
+    rc, out = run_analyzer(str(FIXTURES / fixture))
+    assert rc == 0, f"{fixture} must pass:\n{out}"
+
+
+def test_pragma_suppresses(tmp_path):
+    # readback_ok.py contains a genuine sync carrying the pragma: with
+    # the pragma the file passes, with it stripped the same file fails —
+    # both halves, or the test can't tell suppression from a dead rule
+    src = (FIXTURES / "readback_ok.py").read_text()
+    assert "# pilosa: allow(readback)" in src
+    rc, _ = run_analyzer(str(FIXTURES / "readback_ok.py"))
+    assert rc == 0
+    stripped = tmp_path / "readback_stripped.py"
+    stripped.write_text(src.replace("# pilosa: allow(readback)", ""))
+    rc, out = run_analyzer(str(stripped), "--rule", "readback")
+    assert rc != 0, "stripping the pragma must surface the violation"
+    assert "[readback]" in out
+
+
+# ------------------------------------------------------ mutated live tree
+@pytest.fixture
+def tree_copy(tmp_path):
+    dst = tmp_path / "repo"
+    (dst / "docs").parent.mkdir(parents=True, exist_ok=True)
+    shutil.copytree(
+        REPO / "pilosa_tpu",
+        dst / "pilosa_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
+    shutil.copytree(REPO / "docs", dst / "docs")
+    return dst
+
+
+def mutate(path: Path, old: str, new: str) -> None:
+    text = path.read_text()
+    assert old in text, f"mutation anchor missing from {path}: {old!r}"
+    path.write_text(text.replace(old, new))
+
+
+def check_tree(root: Path) -> tuple[int, str]:
+    return run_analyzer(str(root / "pilosa_tpu"), "--root", str(root))
+
+
+def test_tree_copy_baseline_clean(tree_copy):
+    rc, out = check_tree(tree_copy)
+    assert rc == 0, out
+
+
+def test_parity_missing_host_method_fails(tree_copy):
+    # remove a whole hostpath call type: the exact scenario the rule
+    # exists for — the router would 500 any TopN it sends host-side
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "hostpath.py",
+        "def topn_pairs(",
+        "def topn_pairs_removed(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "topn_pairs" in out
+
+
+def test_parity_missing_planner_branch_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "hostpath.py",
+        'if name == "Shift":',
+        'if name == "ShiftDisabled":',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "'Shift'" in out
+
+
+def test_observability_missing_handler_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "http.py",
+        "def h_version(",
+        "def x_version(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "version" in out
+
+
+def test_observability_untimed_fanout_fails(tree_copy):
+    # strip every timing call: the one function that wraps
+    # client.query_node (_timed_query_node) loses its histogram and the
+    # per-leg latency contract goes dark
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        "stats.timing(",
+        "stats.notiming_(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "query_node" in out
+
+
+def test_config_drift_undocumented_field_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "utils" / "config.py",
+        'bind: str = "127.0.0.1:10101"',
+        'bind: str = "127.0.0.1:10101"\n    brand_new_knob: int = 7',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[config-drift]" in out and "brand_new_knob" in out
+
+
+def test_config_drift_undocumented_env_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "utils" / "probecache.py",
+        '"PILOSA_TPU_PROBE_CACHE"',
+        '"PILOSA_TPU_SECRET_KNOB"',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[config-drift]" in out and "PILOSA_TPU_SECRET_KNOB" in out
+
+
+def test_config_drift_stale_doc_key_fails(tree_copy):
+    mutate(
+        tree_copy / "docs" / "configuration.md",
+        "| `bind` |",
+        "| `bind-retired` |",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[config-drift]" in out and "bind-retired" in out
+
+
+def test_readback_leak_in_server_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "diagnostics.py",
+        "    def snapshot(self) -> dict:",
+        "    def snapshot(self) -> dict:\n"
+        "        import jax.numpy as jnp\n"
+        "        import numpy as np\n"
+        "        probe = jnp.zeros(8)\n"
+        "        _leak = float(np.asarray(probe).sum())\n",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[readback]" in out
+
+
+# ----------------------------------------------------------------- fixes
+def _violations_of(path: Path, text: str, rules: list[str]) -> list:
+    tmp = path.parent / ("fixed_" + path.name)
+    tmp.write_text(text)
+    try:
+        project = Project.discover(tmp.parent, [tmp])
+        return [v for v in run_rules(project, only=rules)]
+    finally:
+        tmp.unlink()
+
+
+def test_fix_with_locks_removes_violation_and_is_idempotent(tmp_path):
+    src = (FIXTURES / "locks_bad.py").read_text()
+    fixed = fix_with_locks(src)
+    assert fixed != src
+    assert ".acquire()" not in fixed
+    compile(fixed, "<fixed>", "exec")  # still valid python
+    p = tmp_path / "locks_case.py"
+    vs = _violations_of(p, fixed, ["raw-acquire"])
+    assert vs == [], f"raw-acquire must be fixed: {[v.format() for v in vs]}"
+    assert fix_with_locks(fixed) == fixed, "second run must be a no-op"
+
+
+def test_fix_monotonic_removes_violation_and_is_idempotent(tmp_path):
+    src = (FIXTURES / "banned_bad.py").read_text()
+    fixed = fix_monotonic(src)
+    assert fixed != src
+    compile(fixed, "<fixed>", "exec")
+    # BOTH the duration arithmetic and the feeding assignment move to
+    # the monotonic clock — fixing only one side would be a worse bug
+    assert "time.monotonic() - t0" in fixed
+    assert "t0 = time.monotonic()" in fixed
+    p = tmp_path / "clock_case.py"
+    vs = _violations_of(p, fixed, ["wall-clock"])
+    assert vs == []
+    assert fix_monotonic(fixed) == fixed, "second run must be a no-op"
+
+
+def test_fix_respects_wall_clock_pragmas():
+    # the three intentionally wall-clock sites (persisted TTLs, the
+    # trace epoch anchor) carry pragmas — --fix must not rewrite them
+    from tools.analysis.fixes import apply_fixes
+
+    for rel in (
+        "pilosa_tpu/utils/probecache.py",
+        "pilosa_tpu/core/attrstore.py",
+        "pilosa_tpu/utils/tracing.py",
+    ):
+        src = (REPO / rel).read_text()
+        assert apply_fixes(src) == src, f"--fix must not touch {rel}"
+
+
+def test_fix_monotonic_feed_keys_are_function_scoped():
+    src = (
+        "import time\n\n\n"
+        "def measure():\n"
+        "    t0 = time.time()\n"
+        "    return time.time() - t0\n\n\n"
+        "def stamp():\n"
+        "    t0 = time.time()  # a persisted wall timestamp, same name\n"
+        "    return {'ts': t0}\n"
+    )
+    fixed = fix_monotonic(src)
+    assert "return time.monotonic() - t0" in fixed
+    assert fixed.count("t0 = time.monotonic()") == 1, fixed
+    assert "t0 = time.time()  # a persisted wall timestamp" in fixed
+
+
+def test_empty_target_is_usage_error(tmp_path):
+    empty = tmp_path / "nothing_here"
+    empty.mkdir()
+    rc, out = run_analyzer(str(empty))
+    assert rc == 2, f"zero files must not pass the gate: rc={rc}\n{out}"
+    assert "no python files" in out
+
+
+def test_raw_acquire_wrong_receiver_release(tmp_path):
+    p = tmp_path / "wrong_release.py"
+    p.write_text(
+        "import threading\n"
+        "lock_a = threading.Lock()\n"
+        "lock_b = threading.Lock()\n\n\n"
+        "def leak():\n"
+        "    lock_a.acquire()\n"
+        "    try:\n"
+        "        pass\n"
+        "    finally:\n"
+        "        lock_b.release()  # releases the WRONG lock\n"
+    )
+    rc, out = run_analyzer(str(p), "--rule", "raw-acquire")
+    assert rc != 0, "a finally releasing a different lock must not guard"
+    assert "[raw-acquire]" in out
+
+
+def test_fix_with_locks_nested_pairs(tmp_path):
+    # nested raw pairs in one block, plus an unrelated release after —
+    # the fixer must produce properly nested with-blocks and must not
+    # touch the unrelated line (regression: stale line numbers after
+    # the inner rewrite's deletion once corrupted exactly this shape)
+    src = (
+        "import threading\n"
+        "a_lock = threading.Lock()\n"
+        "b_lock = threading.Lock()\n"
+        "c_lock = threading.Lock()\n\n\n"
+        "def nested():\n"
+        "    a_lock.acquire()\n"
+        "    b_lock.acquire()\n"
+        "    work()\n"
+        "    b_lock.release()\n"
+        "    a_lock.release()\n"
+        "    c_lock.release()\n\n\n"
+        "def work():\n"
+        "    pass\n"
+    )
+    fixed = fix_with_locks(src)
+    compile(fixed, "<fixed>", "exec")
+    assert "with a_lock:" in fixed and "with b_lock:" in fixed
+    assert ".acquire()" not in fixed
+    assert "a_lock.release()" not in fixed and "b_lock.release()" not in fixed
+    assert "c_lock.release()" in fixed, "unrelated release must survive"
+    p = tmp_path / "nested_case.py"
+    vs = _violations_of(p, fixed, ["raw-acquire"])
+    assert vs == [], [v.format() for v in vs]
+    assert fix_with_locks(fixed) == fixed
+
+
+def test_fix_with_locks_skips_early_release_in_nested_block():
+    # an early release inside an if-block between the pair breaks the
+    # simple pattern: rewriting would double-release (RuntimeError) on
+    # the early path — the fixer must leave it alone (rule keeps firing)
+    src = (
+        "import threading\n"
+        "lock = threading.Lock()\n\n\n"
+        "def tricky(err):\n"
+        "    lock.acquire()\n"
+        "    if err:\n"
+        "        lock.release()\n"
+        "        return None\n"
+        "    work()\n"
+        "    lock.release()\n"
+        "    return True\n\n\n"
+        "def work():\n"
+        "    pass\n"
+    )
+    assert fix_with_locks(src) == src
+
+
+def test_fix_monotonic_module_scope_skips_function_locals():
+    # a module-level duration must not drag a same-named assignment in
+    # an unrelated function onto the monotonic clock
+    src = (
+        "import time\n\n"
+        "t0 = time.time()\n"
+        "elapsed = time.time() - t0\n\n\n"
+        "def stamp():\n"
+        "    t0 = time.time()  # persisted wall timestamp\n"
+        "    return {'ts': t0}\n"
+    )
+    fixed = fix_monotonic(src)
+    assert "elapsed = time.monotonic() - t0" in fixed
+    assert fixed.splitlines()[2] == "t0 = time.monotonic()"
+    assert "    t0 = time.time()  # persisted wall timestamp" in fixed
+
+
+def test_fix_with_locks_skips_multiline_strings():
+    # reindenting body lines would rewrite a triple-quoted constant's
+    # VALUE — such blocks must be left alone (the rule keeps firing)
+    src = (
+        "import threading\n"
+        "lock = threading.Lock()\n\n\n"
+        "def docy():\n"
+        "    lock.acquire()\n"
+        '    doc = """a\n'
+        'b"""\n'
+        "    lock.release()\n"
+        "    return doc\n"
+    )
+    assert fix_with_locks(src) == src
+
+
+def test_fix_cli_flag(tmp_path):
+    target = tmp_path / "locks_cli.py"
+    target.write_text((FIXTURES / "locks_bad.py").read_text())
+    rc, _ = run_analyzer(str(target), "--rule", "raw-acquire")
+    assert rc != 0
+    rc, out = run_analyzer(str(target), "--rule", "raw-acquire", "--fix")
+    assert rc == 0, out
+    # rerunning --fix on the fixed file changes nothing
+    before = target.read_text()
+    rc, _ = run_analyzer(str(target), "--rule", "raw-acquire", "--fix")
+    assert rc == 0
+    assert target.read_text() == before

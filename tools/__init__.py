@@ -1,0 +1,2 @@
+"""Repo tooling: the static-analysis suite (tools.analysis) and the
+single-entry check runner (tools/check.py)."""

@@ -1,0 +1,24 @@
+"""Repo-specific static-analysis suite (``python -m tools.analysis``).
+
+Rules (see docs/static-analysis.md):
+
+- ``readback``        device→host syncs outside executor/ + parallel/
+- ``raw-acquire``     lock.acquire() without `with` or try/finally
+- ``lock-order``      cycles in the holds-A-while-acquiring-B graph
+- ``parity``          executor vs hostpath call-type dispatch drift
+- ``observability``   untraced/untimed HTTP routes and fan-out legs
+- ``config-drift``    config keys/env vars vs docs/configuration.md
+- ``bare-except`` / ``broad-except`` / ``mutable-default`` /
+  ``wall-clock``      banned patterns
+
+Suppress a finding with an inline ``# pilosa: allow(<rule>)`` pragma on
+the flagged line.  ``--fix`` applies the mechanical autofixes
+(with-statement locks, monotonic clock).
+"""
+
+from tools.analysis.engine import (  # noqa: F401
+    Project,
+    Violation,
+    get_rules,
+    run,
+)

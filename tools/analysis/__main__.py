@@ -1,0 +1,115 @@
+"""CLI: ``python -m tools.analysis [paths...] [--rule R] [--fix]``.
+
+Exit status: 0 = clean, 1 = violations, 2 = usage error.  The tier-1
+gate (tests/test_analysis.py) runs this over the live tree and over
+seeded-violation fixtures and asserts on the exit codes.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from tools.analysis.engine import Project, get_rules, run
+from tools.analysis.fixes import apply_fixes
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description="repo-specific AST invariant analyzer",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        default=["pilosa_tpu"],
+        help="files or directories to analyze (default: pilosa_tpu)",
+    )
+    ap.add_argument(
+        "--root",
+        default=None,
+        help="project root anchoring relative paths (default: cwd or the "
+        "repo containing the first path)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        help="run only this rule (repeatable)",
+    )
+    ap.add_argument(
+        "--fix",
+        action="store_true",
+        help="apply the mechanical autofixes (with-locks, monotonic) "
+        "in place, then re-check",
+    )
+    ap.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for name, r in sorted(get_rules().items()):
+            print(f"{name:16s} {r.doc}")
+        return 0
+
+    paths = args.paths or ["pilosa_tpu"]
+    if args.root:
+        root = Path(args.root).resolve()
+    else:
+        first = Path(paths[0]).resolve()
+        anchor = first if first.is_dir() else first.parent
+        # walk up to the repo root (the dir holding tools/ or .git) so
+        # project-relative suffixes match regardless of invocation dir
+        root = anchor
+        for cand in [anchor, *anchor.parents]:
+            if (cand / "tools").is_dir() or (cand / ".git").exists():
+                root = cand
+                break
+    try:
+        project = Project.discover(root, [Path(p) for p in paths])
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not project.files:
+        # a gate that silently checks zero files is a green light for
+        # anything — a typo'd path or wrong cwd must fail loudly
+        print(
+            f"error: no python files found under {', '.join(paths)} "
+            f"(cwd: {Path.cwd()})",
+            file=sys.stderr,
+        )
+        return 2
+
+    if args.fix:
+        changed = 0
+        for f in project.files:
+            fixed = apply_fixes(f.text)
+            if fixed != f.text:
+                f.abspath.write_text(fixed, encoding="utf-8")
+                changed += 1
+        if changed:
+            print(f"--fix rewrote {changed} file(s)")
+            project = Project.discover(root, [Path(p) for p in paths])
+
+    try:
+        violations = run(project, only=args.rules)
+    except KeyError as e:
+        print(f"error: {e.args[0]}", file=sys.stderr)
+        return 2
+    for v in violations:
+        print(v.format())
+    n_files = len(project.files)
+    if violations:
+        print(
+            f"\n{len(violations)} violation(s) across {n_files} file(s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(f"OK: {n_files} file(s) clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

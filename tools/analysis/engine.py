@@ -1,0 +1,245 @@
+"""Core machinery for the repo-specific static-analysis suite.
+
+The suite is AST-based (stdlib ``ast`` only — it must run in any
+environment the tests run in, with zero third-party dependencies) and
+rule-oriented: each rule is a function ``check(project) -> [Violation]``
+registered under a short name.  Rules encode CROSS-CUTTING invariants
+that no off-the-shelf linter knows about — host/device readback
+boundaries, lock ordering, executor/hostpath call-type parity,
+observability completeness, config/docs drift — plus a few banned
+patterns (bare excepts, mutable default args, wall-clock latency math).
+
+Suppression: a violation on line N is suppressed when line N itself —
+the exact line the violation reports — carries an inline pragma
+
+    # pilosa: allow(<rule>[, <rule>...])
+
+naming the rule.  (For a multi-line statement the pragma goes on the
+line the rule anchors to, which is where the flagged expression
+starts.)  ``# noqa: BLE001`` is honored as an alias for
+``allow(broad-except)`` so pre-existing annotations keep working.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Callable, Iterable
+
+_PRAGMA_RE = re.compile(r"#\s*pilosa:\s*allow\(([^)]*)\)")
+_NOQA_BLE_RE = re.compile(r"#\s*noqa:[^\n]*\bBLE001\b")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str  # project-relative, posix separators
+    line: int
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed source file plus its suppression pragmas."""
+
+    def __init__(self, root: Path, path: Path):
+        self.abspath = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text(encoding="utf-8")
+        self.lines = self.text.splitlines()
+        self.tree: ast.Module | None = None
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree = ast.parse(self.text, filename=str(path))
+        except SyntaxError as e:
+            self.parse_error = e
+        self._allows: dict[int, set[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _PRAGMA_RE.search(line)
+            if m:
+                names = {s.strip() for s in m.group(1).split(",") if s.strip()}
+                self._allows.setdefault(i, set()).update(names)
+            if _NOQA_BLE_RE.search(line):
+                self._allows.setdefault(i, set()).add("broad-except")
+
+    def allowed(self, rule: str, line: int) -> bool:
+        names = self._allows.get(line)
+        return bool(names) and (rule in names or "*" in names)
+
+    def imports_module(self, *mods: str) -> bool:
+        """True when the file imports any of ``mods`` (top-level or
+        inside a function — deferred imports count)."""
+        if self.tree is None:
+            return False
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                if any(a.name == m or a.name.startswith(m + ".") for a in node.names for m in mods):
+                    return True
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if any(node.module == m or node.module.startswith(m + ".") for m in mods):
+                    return True
+        return False
+
+
+class Project:
+    """The file set one analysis run sees.  ``root`` anchors relative
+    paths (rules locate well-known files like ``executor/hostpath.py``
+    by suffix so the same rule runs against the live tree and against a
+    mutated copy in tests)."""
+
+    def __init__(self, root: Path, paths: Iterable[Path]):
+        self.root = Path(root).resolve()
+        self.files: list[SourceFile] = []
+        seen: set[Path] = set()
+        for p in sorted(Path(p).resolve() for p in paths):
+            if p in seen:
+                continue
+            seen.add(p)
+            self.files.append(SourceFile(self.root, p))
+        self._by_rel = {f.rel: f for f in self.files}
+
+    @classmethod
+    def discover(cls, root: Path, targets: Iterable[Path] | None = None) -> "Project":
+        root = Path(root).resolve()
+        paths: list[Path] = []
+        for t in targets or [root]:
+            t = Path(t)
+            if not t.is_absolute():
+                t = root / t
+            if t.is_dir():
+                paths.extend(
+                    p
+                    for p in t.rglob("*.py")
+                    if "__pycache__" not in p.parts
+                )
+            elif t.suffix == ".py":
+                paths.append(t)
+        return cls(root, paths)
+
+    def find(self, suffix: str) -> SourceFile | None:
+        """The unique file whose project-relative path ends with
+        ``suffix`` (posix separators) — None when absent or ambiguous."""
+        hits = [
+            f
+            for f in self.files
+            if f.rel == suffix or f.rel.endswith("/" + suffix)
+        ]
+        return hits[0] if len(hits) == 1 else None
+
+    def doc(self, relpath: str) -> str | None:
+        """Text of a non-Python project file (docs), or None."""
+        p = self.root / relpath
+        try:
+            return p.read_text(encoding="utf-8")
+        except OSError:
+            return None
+
+
+@dataclass
+class Rule:
+    name: str
+    doc: str
+    check: Callable[[Project], list[Violation]]
+    # rules that only make sense against the real tree (they look for
+    # specific files) report nothing when those files are absent
+    fixer: Callable[[SourceFile], str | None] | None = field(default=None)
+
+
+_RULES: dict[str, Rule] = {}
+
+
+def rule(name: str, doc: str):
+    """Decorator registering a rule check function."""
+
+    def deco(fn: Callable[[Project], list[Violation]]):
+        _RULES[name] = Rule(name=name, doc=doc, check=fn)
+        return fn
+
+    return deco
+
+
+def get_rules() -> dict[str, Rule]:
+    # importing the rules package populates the registry
+    from tools.analysis import rules as _  # noqa: F401
+
+    return dict(_RULES)
+
+
+def filter_suppressed(project: Project, violations: list[Violation]) -> list[Violation]:
+    out = []
+    for v in violations:
+        f = project._by_rel.get(v.path)
+        if f is not None and f.allowed(v.rule, v.line):
+            continue
+        out.append(v)
+    return out
+
+
+def run(
+    project: Project, only: Iterable[str] | None = None
+) -> list[Violation]:
+    rules = get_rules()
+    names = list(only) if only else sorted(rules)
+    unknown = [n for n in names if n not in rules]
+    if unknown:
+        raise KeyError(f"unknown rule(s): {', '.join(unknown)}")
+    violations: list[Violation] = []
+    for f in project.files:
+        if f.parse_error is not None:
+            violations.append(
+                Violation(
+                    "syntax",
+                    f.rel,
+                    f.parse_error.lineno or 1,
+                    f"file does not parse: {f.parse_error.msg}",
+                )
+            )
+    for n in names:
+        violations.extend(rules[n].check(project))
+    violations = filter_suppressed(project, violations)
+    violations.sort(key=lambda v: (v.path, v.line, v.rule))
+    return violations
+
+
+# ----------------------------------------------------------- AST helpers
+def call_name(node: ast.AST) -> str:
+    """Dotted name of a call's function expression ('' when dynamic):
+    ``np.asarray`` → "np.asarray", ``x.block_until_ready`` →
+    "x.block_until_ready" (the leading receiver kept only when it is a
+    plain name)."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+    elif parts:
+        parts.append("<expr>")
+    else:
+        return ""
+    return ".".join(reversed(parts))
+
+
+def string_constants(node: ast.AST) -> set[str]:
+    return {
+        n.value
+        for n in ast.walk(node)
+        if isinstance(n, ast.Constant) and isinstance(n.value, str)
+    }
+
+
+def functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def classdefs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node

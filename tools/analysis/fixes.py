@@ -1,0 +1,270 @@
+"""Autofixes for the two mechanical rules.
+
+Both fixes are TEXTUAL rewrites guided by AST positions, applied only
+where the corresponding rule actually fired, and IDEMPOTENT: a second
+run over fixed source is a no-op (tests/test_analysis.py proves it).
+
+``fix_monotonic``  wall-clock rule: rewrites ``time.time()`` to
+``time.monotonic()`` inside flagged duration arithmetic, AND rewrites
+the assignments that feed those expressions (``x = time.time()`` where
+``x`` is the other operand of a flagged BinOp) — fixing only one side
+would subtract a wall-clock start from a monotonic now, which is worse
+than the original bug.
+
+``fix_with_locks``  raw-acquire rule: rewrites the simple pattern
+
+    lock.acquire()
+    <body...>
+    lock.release()
+
+(same block, same receiver, no intervening release consumers) into
+
+    with lock:
+        <body...>
+
+Anything more complex is left for a human — the rule keeps flagging it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import _PRAGMA_RE
+from tools.analysis.rules.banned import _is_time_time
+from tools.analysis.rules.locks import _lock_id
+
+
+def _span_replace(
+    lines: list[str], node: ast.AST, old: str, new: str
+) -> bool:
+    """Replace the first occurrence of ``old`` within ``node``'s source
+    span (single-line nodes only)."""
+    ln = node.lineno - 1
+    if node.end_lineno != node.lineno:
+        return False
+    line = lines[ln]
+    col = line.find(old, node.col_offset)
+    if col < 0:
+        return False
+    lines[ln] = line[:col] + new + line[col + len(old) :]
+    return True
+
+
+def _operand_key(node: ast.AST) -> str | None:
+    """Stable textual identity for the non-time operand of a flagged
+    BinOp (a Name or dotted attribute)."""
+    parts = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def fix_monotonic(source: str) -> str:
+    """Apply the wall-clock autofix to one module's source.
+
+    Pragma-aware (a ``# pilosa: allow(wall-clock)`` on the flagged line
+    means the wall clock is intentional — persisted timestamps must NOT
+    be rewritten), and feed-assignment matching is scoped PER FUNCTION:
+    a same-named timestamp variable in an unrelated function is someone
+    else's wall clock, not this duration's start."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source
+    lines = source.splitlines(keepends=False)
+    trailing_nl = source.endswith("\n")
+
+    def allowed(lineno: int) -> bool:
+        line = lines[lineno - 1] if 0 < lineno <= len(lines) else ""
+        m = _PRAGMA_RE.search(line)
+        return bool(m) and (
+            "wall-clock" in m.group(1) or "*" in m.group(1)
+        )
+
+    def scope_walk(scope: ast.AST):
+        """Walk a scope WITHOUT descending into nested function
+        definitions — a name in an inner function is that function's
+        variable, not this scope's (the whole point of the scoping)."""
+        stack: list[ast.AST] = [scope]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                stack.append(child)
+
+    # every function is its own scope; the module top level is one more
+    scopes: list[ast.AST] = [
+        n
+        for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ] + [tree]
+    flagged_calls: list[ast.Call] = []
+    for scope in scopes:
+        feed_keys: set[str] = set()
+        binops: list[ast.BinOp] = []
+        for node in scope_walk(scope):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                binops.append(node)
+        for node in binops:
+            sides = (node.left, node.right)
+            if not any(_is_time_time(s) for s in sides):
+                continue
+            if allowed(node.lineno):
+                continue
+            for s in sides:
+                if _is_time_time(s):
+                    flagged_calls.append(s)  # type: ignore[arg-type]
+                else:
+                    key = _operand_key(s)
+                    if key is not None:
+                        feed_keys.add(key)
+        if not feed_keys:
+            continue
+        # assignments IN THIS SCOPE ONLY that feed a flagged duration
+        for node in scope_walk(scope):
+            if (
+                isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_time_time(node.value)
+                and not allowed(node.lineno)
+            ):
+                for tgt in node.targets:
+                    key = _operand_key(tgt)
+                    if key is not None and key in feed_keys:
+                        flagged_calls.append(node.value)
+    for call in flagged_calls:
+        _span_replace(lines, call, "time.time()", "time.monotonic()")
+    out = "\n".join(lines)
+    return out + "\n" if trailing_nl else out
+
+
+def _receiver_text(call: ast.Call) -> str | None:
+    fn = call.func
+    if not (isinstance(fn, ast.Attribute) and fn.attr in ("acquire", "release")):
+        return None
+    if _lock_id(fn.value, None) is None:
+        return None
+    try:
+        return ast.unparse(fn.value)
+    except Exception:  # pilosa: allow(broad-except) — best-effort unparse
+        return None
+
+
+def _spans_lines(node: ast.AST) -> bool:
+    """A string/f-string constant spanning physical lines: reindenting
+    its continuation lines would rewrite the VALUE, not the layout."""
+    if not isinstance(node, (ast.Constant, ast.JoinedStr)):
+        return False
+    if isinstance(node, ast.Constant) and not isinstance(
+        node.value, (str, bytes)
+    ):
+        return False
+    return (node.end_lineno or node.lineno) > node.lineno
+
+
+def _next_lock_rewrite(tree: ast.Module) -> tuple[int, int, int] | None:
+    """The DEEPEST (acquire_line, release_line, col) raw acquire/release
+    pair, or None.  Deepest-first matters: rewriting an inner pair
+    deletes a line, so outer pairs must be re-located on fresh source —
+    the caller re-parses between rewrites."""
+    best: tuple[int, int, int] | None = None
+    for node in ast.walk(tree):
+        for seq_name in ("body", "orelse", "finalbody"):
+            seq = getattr(node, seq_name, None)
+            if not isinstance(seq, list):
+                continue
+            for i, stmt in enumerate(seq):
+                if not (
+                    isinstance(stmt, ast.Expr)
+                    and isinstance(stmt.value, ast.Call)
+                ):
+                    continue
+                recv = _receiver_text(stmt.value)
+                if recv is None or stmt.value.func.attr != "acquire":
+                    continue
+                if stmt.value.args or stmt.value.keywords:
+                    continue  # acquire(timeout=...) is not plain sugar
+                for j in range(i + 1, len(seq)):
+                    s2 = seq[j]
+                    if (
+                        isinstance(s2, ast.Expr)
+                        and isinstance(s2.value, ast.Call)
+                        and _receiver_text(s2.value) == recv
+                    ):
+                        if s2.value.func.attr == "release" and not any(
+                            _spans_lines(n) for s in seq[i + 1 : j] for n in ast.walk(s)
+                        ):
+                            # (multi-line string constants in the body
+                            # would be corrupted by the reindent — skip)
+                            cand = (stmt.lineno, s2.lineno, stmt.col_offset)
+                            if best is None or cand[0] > best[0]:
+                                best = cand
+                        # same receiver again (acquire or re-release): stop
+                        break
+                    # an acquire/release of the SAME receiver nested
+                    # anywhere inside an intervening statement (early
+                    # release in an if-block, conditional re-acquire)
+                    # breaks the simple pattern — rewriting would
+                    # double-release at runtime; leave it for a human
+                    if any(
+                        isinstance(n, ast.Call)
+                        and _receiver_text(n) == recv
+                        for n in ast.walk(s2)
+                    ):
+                        break
+    return best
+
+
+def fix_with_locks(source: str) -> str:
+    """Apply the with-statement autofix to one module's source.
+
+    One rewrite per pass, re-parsing between passes: line numbers from a
+    stale parse must never drive an edit (a nested pair's rewrite
+    deletes a line and would shift every later position)."""
+    for _ in range(100):  # fixpoint; cap is paranoia, not a real bound
+        try:
+            tree = ast.parse(source)
+        except SyntaxError:
+            return source
+        found = _next_lock_rewrite(tree)
+        if found is None:
+            return source
+        acq_ln, rel_ln, col = found
+        lines = source.splitlines(keepends=False)
+        trailing_nl = source.endswith("\n")
+        if rel_ln <= acq_ln or rel_ln > len(lines):
+            return source
+        indent = " " * col
+        acq_line = lines[acq_ln - 1]
+        rel_line = lines[rel_ln - 1]
+        if not acq_line.strip().endswith(".acquire()"):
+            return source  # trailing comment etc. — leave for a human
+        recv_src = acq_line.strip()[: -len(".acquire()")]
+        # the release LINE must be exactly this receiver's release — a
+        # textual mismatch (comment, different receiver) aborts rather
+        # than deleting a line the AST match didn't actually point at
+        if rel_line.strip() != f"{recv_src}.release()":
+            return source
+        lines[acq_ln - 1] = f"{indent}with {recv_src}:"
+        for k in range(acq_ln, rel_ln - 1):
+            if lines[k].strip():
+                lines[k] = "    " + lines[k]
+        del lines[rel_ln - 1]
+        if rel_ln - 1 == acq_ln:
+            # empty body: with needs a pass
+            lines.insert(acq_ln, f"{indent}    pass")
+        source = "\n".join(lines) + ("\n" if trailing_nl else "")
+    return source
+
+
+def apply_fixes(source: str) -> str:
+    return fix_with_locks(fix_monotonic(source))

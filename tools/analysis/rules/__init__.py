@@ -1,0 +1,11 @@
+"""Rule registry — importing this package registers every rule with
+the engine (tools.analysis.engine.get_rules)."""
+
+from tools.analysis.rules import (  # noqa: F401
+    banned,
+    configdrift,
+    locks,
+    observability,
+    parity,
+    readback,
+)

@@ -1,0 +1,164 @@
+"""Banned-pattern rules: bare excepts, over-broad excepts, mutable
+default arguments, and wall-clock latency arithmetic.
+
+``wall-clock`` is the one with repo-specific teeth: latency and uptime
+must be measured on ``time.monotonic()`` / ``time.perf_counter()`` — a
+stats/router path that computes a duration from ``time.time()`` moves
+backwards under NTP steps, and the router's calibration EWMAs would
+fold a negative latency straight into the crossover.  Wall clock stays
+legitimate for *timestamps* (persisted probe verdicts, tombstone
+horizons, trace anchors) — those sites carry an explicit
+``# pilosa: allow(wall-clock)`` pragma stating why.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, rule
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _exc_names(node: ast.expr | None):
+    if node is None:
+        return []
+    if isinstance(node, ast.Tuple):
+        return [n for e in node.elts for n in _exc_names(e)]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    return []
+
+
+@rule(
+    "bare-except",
+    "`except:` swallows KeyboardInterrupt/SystemExit — name the exceptions",
+)
+def check_bare_except(project: Project) -> list[Violation]:
+    out = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                out.append(
+                    Violation(
+                        "bare-except",
+                        f.rel,
+                        node.lineno,
+                        "bare `except:` — catch specific exceptions "
+                        "(a bare clause also eats SystemExit on shutdown)",
+                    )
+                )
+    return out
+
+
+def _reraises(handler: ast.ExceptHandler) -> bool:
+    """Cleanup-then-reraise handlers (a bare ``raise`` in the body) are
+    the legitimate use of broad catches — they swallow nothing."""
+    return any(
+        isinstance(n, ast.Raise) and n.exc is None
+        for n in ast.walk(ast.Module(body=handler.body, type_ignores=[]))
+    )
+
+
+@rule(
+    "broad-except",
+    "`except Exception` without a pragma can swallow shutdown/RPC errors",
+)
+def check_broad_except(project: Project) -> list[Violation]:
+    out = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = [n for n in _exc_names(node.type) if n in _BROAD]
+            if broad and not _reraises(node):
+                out.append(
+                    Violation(
+                        "broad-except",
+                        f.rel,
+                        node.lineno,
+                        f"`except {broad[0]}` — narrow it, or annotate "
+                        "why broad is required with "
+                        "`# pilosa: allow(broad-except)`",
+                    )
+                )
+    return out
+
+
+@rule(
+    "mutable-default",
+    "mutable default argument values are shared across calls",
+)
+def check_mutable_default(project: Project) -> list[Violation]:
+    out = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            defaults = list(fn.args.defaults) + [
+                d for d in fn.args.kw_defaults if d is not None
+            ]
+            for d in defaults:
+                bad = isinstance(d, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(d, ast.Call)
+                    and isinstance(d.func, ast.Name)
+                    and d.func.id in ("list", "dict", "set")
+                )
+                if bad:
+                    out.append(
+                        Violation(
+                            "mutable-default",
+                            f.rel,
+                            d.lineno,
+                            f"mutable default argument in {fn.name}() — "
+                            "use None and create inside the function",
+                        )
+                    )
+    return out
+
+
+def _is_time_time(node: ast.AST) -> bool:
+    return (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "time"
+        and isinstance(node.func.value, ast.Name)
+        and node.func.value.id == "time"
+    )
+
+
+@rule(
+    "wall-clock",
+    "durations computed from time.time() — use time.monotonic()",
+)
+def check_wall_clock(project: Project) -> list[Violation]:
+    out = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.BinOp) or not isinstance(
+                node.op, (ast.Add, ast.Sub)
+            ):
+                continue
+            if _is_time_time(node.left) or _is_time_time(node.right):
+                out.append(
+                    Violation(
+                        "wall-clock",
+                        f.rel,
+                        node.lineno,
+                        "duration arithmetic on time.time() — wall clock "
+                        "steps under NTP; use time.monotonic() (or "
+                        "perf_counter), or mark a true timestamp use "
+                        "with # pilosa: allow(wall-clock)",
+                    )
+                )
+    return out

@@ -1,0 +1,148 @@
+"""Config/docs drift.
+
+``docs/configuration.md`` is the operator contract.  Three diffs keep
+it honest:
+
+1. every ``Config`` dataclass field (``utils/config.py``) appears in
+   the docs as its dashed TOML key;
+2. every ``PILOSA_TPU_*`` env-var literal read anywhere in the package
+   is either derived from a Config field (the generic
+   ``PILOSA_TPU_<FIELD>`` loader covers those) or documented verbatim;
+3. every Config field appears in ``config_template()`` (the
+   ``generate-config`` output an operator starts from), and every
+   dashed key in the docs' tables corresponds to a real Config field —
+   stale docs fail too.
+
+The docs file is located relative to the project root (``docs/
+configuration.md``), so tests can run against a mutated copy.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from tools.analysis.engine import Project, Violation, rule
+
+CONFIG = "utils/config.py"
+DOC = "docs/configuration.md"
+_ENV_RE = re.compile(r"PILOSA_TPU_[A-Z0-9_]+")
+_DOC_KEY_RE = re.compile(r"^\|\s*`([a-z0-9][a-z0-9_-]*)`", re.MULTILINE)
+
+
+def _config_fields(tree: ast.Module) -> dict[str, int]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "Config":
+            out = {}
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    out[stmt.target.id] = stmt.lineno
+            return out
+    return {}
+
+
+def _template_text(tree: ast.Module) -> str:
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and node.name == "config_template"
+        ):
+            return "".join(
+                n.value
+                for n in ast.walk(node)
+                if isinstance(n, ast.Constant) and isinstance(n.value, str)
+            )
+    return ""
+
+
+@rule(
+    "config-drift",
+    "config keys/env vars and docs/configuration.md must agree",
+)
+def check_config_drift(project: Project) -> list[Violation]:
+    cfg = project.find(CONFIG)
+    if cfg is None or cfg.tree is None:
+        return []
+    doc = project.doc(DOC)
+    if doc is None:
+        return []  # mini fixture trees without docs skip the rule
+    out: list[Violation] = []
+    fields = _config_fields(cfg.tree)
+
+    # 1. every Config field documented under its dashed key
+    for name, line in fields.items():
+        key = name.replace("_", "-")
+        if f"`{key}`" not in doc:
+            out.append(
+                Violation(
+                    "config-drift",
+                    cfg.rel,
+                    line,
+                    f"config field {name!r} (TOML key `{key}`) is not "
+                    f"documented in {DOC}",
+                )
+            )
+
+    # 2. every explicit PILOSA_TPU_* env literal covered
+    derived = {f"PILOSA_TPU_{n.upper()}" for n in fields}
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not (
+                isinstance(node, ast.Constant) and isinstance(node.value, str)
+            ):
+                continue
+            for env in _ENV_RE.findall(node.value):
+                if env == "PILOSA_TPU_":
+                    continue
+                if env in derived or env in doc:
+                    continue
+                out.append(
+                    Violation(
+                        "config-drift",
+                        f.rel,
+                        node.lineno,
+                        f"env var {env} is read here but documented "
+                        f"nowhere in {DOC}",
+                    )
+                )
+
+    # 3a. template completeness
+    template = _template_text(cfg.tree)
+    if template:
+        for name, line in fields.items():
+            key = name.replace("_", "-")
+            if f"{key} = " not in template and f'{key} = "' not in template:
+                out.append(
+                    Violation(
+                        "config-drift",
+                        cfg.rel,
+                        line,
+                        f"config field {name!r} missing from "
+                        "config_template() — generate-config hides it "
+                        "from operators",
+                    )
+                )
+
+    # 3b. stale doc keys: every table key is a real field
+    dashed = {n.replace("_", "-") for n in fields}
+    for m in _DOC_KEY_RE.finditer(doc):
+        key = m.group(1)
+        if key in dashed or key in ("toml-key",):
+            continue
+        # compound cells like `route-dispatch-ms` / `route-readback-ms`
+        # list the first key; others are caught by check 1 if missing
+        line = doc[: m.start()].count("\n") + 1
+        out.append(
+            Violation(
+                "config-drift",
+                DOC,
+                line,
+                f"documented key `{key}` does not correspond to any "
+                "Config field — stale docs",
+            )
+        )
+    return out

@@ -1,0 +1,299 @@
+"""Lock discipline: raw acquires and ordering cycles.
+
+Two checks over every analyzed module:
+
+1. **raw-acquire** — every ``<lock>.acquire()`` must be either the
+   sugar of a ``with`` statement (those never appear as raw calls) or
+   immediately guarded by ``try/finally: release()``.  A raw acquire
+   whose release can be skipped by an exception deadlocks the next
+   reader — Go's vet flags the analogous ``Lock`` without ``defer
+   Unlock``; this is the Python port of that check.
+
+2. **lock-order** — a directed graph of "holds A while acquiring B",
+   built from (a) ``with``-statements nested inside other
+   ``with``-statements over lock-like expressions, in the same
+   function, and (b) one level of interprocedural resolution: a call to
+   a method *of the analyzed set* from inside a with-lock block
+   contributes the locks that method acquires.  Any cycle in the graph
+   is a potential AB/BA deadlock between
+   ``core/holder.py``/``core/fragment.py``/``parallel/cluster.py``/
+   ``executor/router.py`` threads and is reported with the full cycle.
+
+Lock identity is lexical: ``ClassName.attr`` for ``self.<attr>`` /
+``obj.<attr>`` expressions whose attribute name looks lock-like
+(contains "lock"), the bare name for locals/globals.  Lexical identity
+over-approximates (two fragments' ``_lock`` collapse into one node) —
+exactly what an ordering check wants: fragment-vs-fragment ordering
+bugs are real deadlocks.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, rule
+
+_LOCKISH = ("lock",)
+
+
+def _lock_id(node: ast.expr, cls: str | None) -> str | None:
+    """Lexical lock identity for a with/acquire receiver, or None when
+    the expression is not lock-like."""
+    if isinstance(node, ast.Attribute):
+        if any(s in node.attr.lower() for s in _LOCKISH):
+            if isinstance(node.value, ast.Name) and node.value.id == "self":
+                return f"{cls or '?'}.{node.attr}"
+            return f"*.{node.attr}"
+        return None
+    if isinstance(node, ast.Name):
+        if any(s in node.id.lower() for s in _LOCKISH):
+            return node.id
+        return None
+    return None
+
+
+def _enclosing_class(tree: ast.Module) -> dict[int, str]:
+    """Map id(function node) -> class name for methods."""
+    out: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            for item in node.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out[id(item)] = node.name
+    return out
+
+
+class _FnInfo:
+    __slots__ = ("name", "cls", "rel", "acquires", "edges", "calls_under")
+
+    def __init__(self, name: str, cls: str | None, rel: str):
+        self.name = name
+        self.cls = cls
+        self.rel = rel
+        self.acquires: set[str] = set()  # locks this fn takes directly
+        self.edges: list[tuple[str, str, int]] = []  # (held, taken, line)
+        # calls made while holding a lock: (held, receiver_kind, callee,
+        # line) — receiver_kind is "self" (resolve within the class) or
+        # "other" (resolve only when the name is unambiguous repo-wide)
+        self.calls_under: list[tuple[str, str, str, int]] = []
+
+
+def _with_locks(item: ast.withitem, cls: str | None) -> str | None:
+    expr = item.context_expr
+    # `with lock:` or `with self._lock:`; also `with lock.acquire_timeout(..)`
+    return _lock_id(expr, cls)
+
+
+def _scan_function(fn, cls: str | None, rel: str) -> tuple[_FnInfo, list[Violation]]:
+    info = _FnInfo(fn.name, cls, rel)
+    violations: list[Violation] = []
+
+    def walk(node: ast.AST, held: tuple[str, ...]) -> None:
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    def visit(child: ast.AST, held: tuple[str, ...]) -> None:
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs analyzed separately
+        new_held = held
+        if isinstance(child, ast.With):
+            taken = [
+                lid
+                for item in child.items
+                if (lid := _with_locks(item, cls)) is not None
+            ]
+            for lid in taken:
+                info.acquires.add(lid)
+                for h in new_held:
+                    if h != lid:
+                        info.edges.append((h, lid, child.lineno))
+                new_held = new_held + (lid,)
+            for sub in child.body:
+                visit(sub, new_held)
+            return
+        if isinstance(child, ast.Call):
+            name = child.func
+            if (
+                isinstance(name, ast.Attribute)
+                and name.attr == "acquire"
+                and (lid := _lock_id(name.value, cls)) is not None
+            ):
+                info.acquires.add(lid)
+                for h in held:
+                    if h != lid:
+                        info.edges.append((h, lid, child.lineno))
+                if not _release_guarded(child, parents):
+                    violations.append(
+                        Violation(
+                            "raw-acquire",
+                            rel,
+                            child.lineno,
+                            f"{lid}.acquire() outside a `with` block "
+                            "and not immediately followed by "
+                            "try/finally release — an exception "
+                            "leaks the lock",
+                        )
+                    )
+            elif isinstance(name, ast.Attribute) and held:
+                # method call while holding: record for the
+                # interprocedural pass
+                kind = (
+                    "self"
+                    if isinstance(name.value, ast.Name)
+                    and name.value.id == "self"
+                    else "other"
+                )
+                for h in held:
+                    info.calls_under.append(
+                        (h, kind, name.attr, child.lineno)
+                    )
+            elif isinstance(name, ast.Name) and held:
+                for h in held:
+                    info.calls_under.append(
+                        (h, "other", name.id, child.lineno)
+                    )
+        walk(child, new_held)
+
+    # parent map for the raw-acquire try/finally check — built once
+    # per scanned function and passed down explicitly (no module-global
+    # side channel: the scan must stay reentrant)
+    parents: dict[int, ast.AST] = {}
+    for n in ast.walk(fn):
+        for c in ast.iter_child_nodes(n):
+            parents[id(c)] = n
+    walk(fn, ())
+    return info, violations
+
+
+def _release_guarded(
+    acquire_call: ast.Call, parents: dict[int, ast.AST]
+) -> bool:
+    """True when the acquire statement is immediately followed, in the
+    same block, by a Try whose finally releases the SAME receiver — a
+    finally that releases some other lock does not guard this one."""
+    stmt = parents.get(id(acquire_call))
+    while stmt is not None and not isinstance(stmt, ast.stmt):
+        stmt = parents.get(id(stmt))
+    if stmt is None:
+        return False
+    parent = parents.get(id(stmt))
+    body = getattr(parent, "body", None)
+    for attr in ("body", "orelse", "finalbody"):
+        seq = getattr(parent, attr, None)
+        if isinstance(seq, list) and stmt in seq:
+            body = seq
+            break
+    if body is None or stmt not in body:
+        return False
+    acquired = ast.dump(acquire_call.func.value)  # type: ignore[attr-defined]
+    i = body.index(stmt)
+    if i + 1 < len(body):
+        nxt = body[i + 1]
+        if isinstance(nxt, ast.Try) and nxt.finalbody:
+            for n in ast.walk(ast.Module(body=nxt.finalbody, type_ignores=[])):
+                if (
+                    isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr == "release"
+                    and ast.dump(n.func.value) == acquired
+                ):
+                    return True
+    return False
+
+
+@rule(
+    "raw-acquire",
+    "lock.acquire() without `with` or try/finally release",
+)
+def check_raw_acquire(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        cls_of = _enclosing_class(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _info, vs = _scan_function(node, cls_of.get(id(node)), f.rel)
+                out.extend(vs)
+    return out
+
+
+@rule(
+    "lock-order",
+    "cycles in the holds-A-while-acquiring-B lock graph",
+)
+def check_lock_order(project: Project) -> list[Violation]:
+    infos: list[_FnInfo] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        cls_of = _enclosing_class(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                info, _vs = _scan_function(node, cls_of.get(id(node)), f.rel)
+                infos.append(info)
+
+    # One-level interprocedural closure: a call to a resolvable method
+    # while holding H adds H -> every lock that method acquires
+    # directly.  Resolution: `self.m()` binds to m in the caller's own
+    # class; `obj.m()` / bare `m()` binds only when exactly ONE analyzed
+    # class (or module) defines an acquiring m — an ambiguous name like
+    # `close` (file close vs Logger.close) must not fabricate edges.
+    by_class: dict[tuple[str | None, str], set[str]] = {}
+    owners: dict[str, set[str | None]] = {}
+    for info in infos:
+        if info.acquires:
+            by_class.setdefault((info.cls, info.name), set()).update(
+                info.acquires
+            )
+            owners.setdefault(info.name, set()).add(info.cls)
+    edges: dict[tuple[str, str], tuple[str, int]] = {}
+    for info in infos:
+        for held, taken, line in info.edges:
+            edges.setdefault((held, taken), (info.rel, line))
+        for held, kind, callee, line in info.calls_under:
+            if kind == "self":
+                targets = by_class.get((info.cls, callee), set())
+            else:
+                cls_set = owners.get(callee, set())
+                targets = (
+                    by_class.get((next(iter(cls_set)), callee), set())
+                    if len(cls_set) == 1
+                    else set()
+                )
+            for taken in targets:
+                if taken != held:
+                    edges.setdefault((held, taken), (info.rel, line))
+
+    graph: dict[str, set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+
+    out: list[Violation] = []
+    reported: set[frozenset] = set()
+
+    def dfs(start: str, node: str, path: list[str], visiting: set[str]) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = frozenset(path)
+                if key not in reported:
+                    reported.add(key)
+                    rel, line = edges[(path[-1], start)]
+                    out.append(
+                        Violation(
+                            "lock-order",
+                            rel,
+                            line,
+                            "lock ordering cycle: "
+                            + " -> ".join(path + [start])
+                            + " (AB/BA deadlock between threads)",
+                        )
+                    )
+            elif nxt not in visiting:
+                visiting.add(nxt)
+                dfs(start, nxt, path + [nxt], visiting)
+                visiting.discard(nxt)
+
+    for n in sorted(graph):
+        dfs(n, n, [n], {n})
+    return out

@@ -1,0 +1,159 @@
+"""Observability completeness.
+
+PR 1's contract: every HTTP route and every /internal/* fan-out leg is
+traced (span) and measured (histogram/counter) — tail latency must
+always be attributable.  Enforced structurally:
+
+1. **routes** — every route name in ``server/http.py``'s ``_ROUTES``
+   literal has a matching ``h_<name>`` method on ``Handler``;
+2. **dispatcher** — ``Handler._dispatch`` (the one chokepoint every
+   route goes through, including the cluster layer's /internal extras)
+   contains a ``GLOBAL_TRACER.span`` call, a ``stats.count`` call and a
+   ``stats.timer``/``stats.timing`` call, so no handler can opt out;
+3. **fan-out** — in ``parallel/cluster.py``, any function that calls
+   ``client.query_node`` (the query scatter RPC) must itself open a
+   ``GLOBAL_TRACER.span`` and record a ``stats.timing``/``timer`` —
+   per-leg latency is the input to the slow-shard naming in the
+   long-query log, so an untimed fan-out silently breaks it.
+
+Files are located by project-relative suffix so tests can run the rule
+against a mutated copy of the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, call_name, rule
+
+HTTP = "server/http.py"
+CLUSTER = "parallel/cluster.py"
+
+
+def _calls_in(node: ast.AST) -> set[str]:
+    return {
+        call_name(n.func)
+        for n in ast.walk(node)
+        if isinstance(n, ast.Call)
+    }
+
+
+def _has_call(node: ast.AST, *suffixes: str) -> bool:
+    calls = _calls_in(node)
+    return any(c.endswith(s) for c in calls for s in suffixes)
+
+
+@rule(
+    "observability",
+    "every HTTP route and /internal fan-out is spanned + histogram-timed",
+)
+def check_observability(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    http = project.find(HTTP)
+    if http is not None and http.tree is not None:
+        routes: list[tuple[str, int]] = []
+        for node in ast.walk(http.tree):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets = [node.target]
+            if any(
+                isinstance(t, ast.Name) and t.id == "_ROUTES"
+                for t in targets
+            ):
+                for elt in getattr(node.value, "elts", []):
+                    if isinstance(elt, ast.Tuple) and elt.elts:
+                        last = elt.elts[-1]
+                        if isinstance(last, ast.Constant) and isinstance(
+                            last.value, str
+                        ):
+                            routes.append((last.value, elt.lineno))
+        handler = None
+        for node in ast.walk(http.tree):
+            if isinstance(node, ast.ClassDef) and node.name == "Handler":
+                handler = node
+                break
+        if handler is not None:
+            methods = {
+                n.name: n
+                for n in handler.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            }
+            for name, line in routes:
+                if f"h_{name}" not in methods:
+                    out.append(
+                        Violation(
+                            "observability",
+                            http.rel,
+                            line,
+                            f"route {name!r} has no h_{name}() handler on "
+                            "Handler — requests 404 at dispatch",
+                        )
+                    )
+            dispatch = methods.get("_dispatch")
+            if dispatch is None:
+                out.append(
+                    Violation(
+                        "observability",
+                        http.rel,
+                        handler.lineno,
+                        "Handler._dispatch missing — the span/metrics "
+                        "chokepoint every route must pass through",
+                    )
+                )
+            else:
+                if not _has_call(dispatch, "GLOBAL_TRACER.span", ".span"):
+                    out.append(
+                        Violation(
+                            "observability",
+                            http.rel,
+                            dispatch.lineno,
+                            "_dispatch opens no tracing span — routes "
+                            "would serve untraced",
+                        )
+                    )
+                if not _has_call(dispatch, "stats.count", ".count"):
+                    out.append(
+                        Violation(
+                            "observability",
+                            http.rel,
+                            dispatch.lineno,
+                            "_dispatch records no http_requests counter",
+                        )
+                    )
+                if not _has_call(dispatch, ".timer", ".timing"):
+                    out.append(
+                        Violation(
+                            "observability",
+                            http.rel,
+                            dispatch.lineno,
+                            "_dispatch records no per-route latency "
+                            "histogram (stats.timer/timing)",
+                        )
+                    )
+
+    cluster = project.find(CLUSTER)
+    if cluster is not None and cluster.tree is not None:
+        for node in ast.walk(cluster.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _has_call(node, "client.query_node"):
+                continue
+            missing = []
+            if not _has_call(node, "GLOBAL_TRACER.span", ".span"):
+                missing.append("tracing span")
+            if not _has_call(node, ".timing", ".timer"):
+                missing.append("latency histogram")
+            if missing:
+                out.append(
+                    Violation(
+                        "observability",
+                        cluster.rel,
+                        node.lineno,
+                        f"fan-out {node.name}() calls client.query_node "
+                        f"without a {' or '.join(missing)} — per-leg "
+                        "latency becomes unattributable",
+                    )
+                )
+    return out

@@ -1,0 +1,208 @@
+"""Host/device call-type parity.
+
+PR 2's routing contract: the host engine (``executor/hostpath.py``)
+must cover every PQL call type the device executor
+(``executor/executor.py``) handles — the router may send ANY read to
+either engine, so a gap is a runtime 500 on whichever query the cost
+model happens to route host-side that day.  Three static diffs:
+
+1. every ``compiler.host.<method>`` the executor references must exist
+   as a method of ``HostEngine``;
+2. every name in the executor's ``BITMAP_CALLS`` literal must be
+   handled by ``HostPlanner`` (its ``plan``/``_plan_row`` string
+   comparisons);
+3. every read call type dispatched in ``Executor._execute_call`` must
+   reach a ``compiler.host`` reference — directly in its branch or via
+   one ``self._execute_*`` hop (writes, ``Options`` and the
+   metadata-only ``Rows`` are exempt).
+
+The rule locates the two files by project-relative suffix, so tests can
+run it against a mutated copy of the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import (
+    Project,
+    Violation,
+    call_name,
+    string_constants,
+    rule,
+)
+
+EXECUTOR = "executor/executor.py"
+HOSTPATH = "executor/hostpath.py"
+_EXEMPT = {"Options", "Rows"}
+
+
+def _set_literal(tree: ast.Module, name: str) -> set[str]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return string_constants(node.value)
+    return set()
+
+
+def _class(tree: ast.Module, name: str) -> ast.ClassDef | None:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == name:
+            return node
+    return None
+
+
+def _methods(cls: ast.ClassDef) -> dict[str, ast.FunctionDef]:
+    return {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _host_attr_refs(node: ast.AST) -> set[str]:
+    """Attribute names reached through a ``...host.<attr>`` chain."""
+    out = set()
+    for n in ast.walk(node):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Attribute)
+            and n.value.attr == "host"
+        ):
+            out.add(n.attr)
+    return out
+
+
+def _compared_names(fn: ast.AST, var: str = "name") -> set[str]:
+    """String constants compared (==, in) against ``var`` in a function."""
+    out: set[str] = set()
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Compare):
+            continue
+        sides = [n.left] + list(n.comparators)
+        if not any(isinstance(s, ast.Name) and s.id == var for s in sides):
+            continue
+        for s in sides:
+            out.update(string_constants(s))
+    return out
+
+
+@rule(
+    "parity",
+    "executor/hostpath call-type dispatch tables must not drift",
+)
+def check_parity(project: Project) -> list[Violation]:
+    ex = project.find(EXECUTOR)
+    hp = project.find(HOSTPATH)
+    if ex is None or hp is None or ex.tree is None or hp.tree is None:
+        return []  # not this project's layout (fixture mini-trees skip)
+    out: list[Violation] = []
+
+    engine = _class(hp.tree, "HostEngine")
+    planner = _class(hp.tree, "HostPlanner")
+    if engine is None or planner is None:
+        return [
+            Violation(
+                "parity",
+                hp.rel,
+                1,
+                "hostpath.py must define HostEngine and HostPlanner",
+            )
+        ]
+    engine_methods = set(_methods(engine))
+
+    # 1. every compiler.host.<X> used by the executor exists on HostEngine
+    for n in ast.walk(ex.tree):
+        if (
+            isinstance(n, ast.Attribute)
+            and isinstance(n.value, ast.Attribute)
+            and n.value.attr == "host"
+            and n.attr not in engine_methods
+        ):
+            out.append(
+                Violation(
+                    "parity",
+                    ex.rel,
+                    n.lineno,
+                    f"executor references compiler.host.{n.attr}() but "
+                    "HostEngine defines no such method — the host route "
+                    "would 500 on this call type",
+                )
+            )
+
+    # 2. every BITMAP_CALLS name is handled by HostPlanner
+    bitmap_calls = _set_literal(ex.tree, "BITMAP_CALLS")
+    planner_methods = _methods(planner)
+    handled: set[str] = set()
+    for m in planner_methods.values():
+        handled |= _compared_names(m, "name")
+    # names handled via dedicated branches that don't compare `name`
+    # (e.g. _plan_row serving both Row and Range) are already covered by
+    # plan()'s comparison; condition-only constructs don't count
+    for name in sorted(bitmap_calls - handled):
+        out.append(
+            Violation(
+                "parity",
+                hp.rel,
+                planner.lineno,
+                f"bitmap call {name!r} (executor BITMAP_CALLS) has no "
+                "HostPlanner handler — host-routed queries would fail",
+            )
+        )
+
+    # 3. every dispatched read call type reaches a compiler.host reference
+    executor_cls = _class(ex.tree, "Executor")
+    if executor_cls is None:
+        return out
+    methods = _methods(executor_cls)
+    exec_call = methods.get("_execute_call")
+    if exec_call is None:
+        return out
+    write_calls = _set_literal(ex.tree, "WRITE_CALLS")
+    read_names = (
+        _compared_names(exec_call, "name") - write_calls - _EXEMPT - bitmap_calls
+    )
+
+    def branch_covers(name: str) -> bool:
+        """Does the `name == X` branch (or its one-hop self._execute_*
+        callee) reference compiler.host?"""
+        for n in ast.walk(exec_call):
+            if not isinstance(n, ast.If):
+                continue
+            if name not in _compared_names_of_test(n.test):
+                continue
+            body = ast.Module(body=n.body, type_ignores=[])
+            if _host_attr_refs(body):
+                return True
+            for c in ast.walk(body):
+                if isinstance(c, ast.Call):
+                    cn = call_name(c.func)
+                    if cn.startswith("self."):
+                        callee = methods.get(cn.split(".", 1)[1])
+                        if callee is not None and _host_attr_refs(callee):
+                            return True
+        return False
+
+    def _compared_names_of_test(test: ast.AST) -> set[str]:
+        return (
+            _compared_names(ast.Expression(body=test), "name")
+            if isinstance(test, ast.Compare)
+            else set()
+        )
+
+    # bitmap calls are covered via the planner; aggregate/groupby reads
+    # must each have a host branch
+    for name in sorted(read_names):
+        if not branch_covers(name):
+            out.append(
+                Violation(
+                    "parity",
+                    ex.rel,
+                    exec_call.lineno,
+                    f"read call {name!r} is dispatched by _execute_call "
+                    "but its branch never reaches compiler.host — no "
+                    "host-engine coverage for this call type",
+                )
+            )
+    return out

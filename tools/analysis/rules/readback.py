@@ -1,0 +1,146 @@
+"""Host/device boundary rule.
+
+The executor's whole latency story (docs/query-routing.md) rests on one
+invariant: a query pays AT MOST ONE device→host sync, in the executor's
+readback wave.  Any other code that forces a sync on a JAX value —
+``np.asarray`` / ``np.array`` / ``float()`` / ``int()`` / ``.item()`` /
+``.block_until_ready()`` / ``jax.device_get`` — re-introduces the ~70 ms
+per-sync stall the cost router exists to avoid (PR 2), silently, from
+anywhere.
+
+Sanctioned readback layer: modules under ``executor/`` and
+``parallel/`` (the readback wave, the compiler's host bridge, the mesh
+gather paths).  Everywhere else, in any module that imports jax:
+
+- ``.block_until_ready()`` and ``jax.device_get(...)`` are flagged
+  unconditionally (they have no host-side meaning);
+- the host-coercion calls are flagged only when their argument visibly
+  derives from a device value — a ``jnp.*`` / ``jax.*`` subexpression,
+  or a local name assigned from one in the same function (a light
+  intra-function taint; it will not catch laundering through
+  containers, but it catches the way this mistake is actually made).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, call_name, functions, rule
+
+SANCTIONED_PREFIXES = ("pilosa_tpu/executor/", "pilosa_tpu/parallel/")
+_ALWAYS_SYNC = ("block_until_ready",)
+_COERCE_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array"}
+_COERCE_BUILTINS = {"float", "int"}
+
+
+def _is_device_expr(node: ast.AST, tainted: set[str]) -> bool:
+    """Does this expression visibly involve a jax/jnp value?"""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+        if isinstance(n, ast.Attribute):
+            root = n
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("jnp", "jax"):
+                return True
+    return False
+
+
+def _taint(fn: ast.AST) -> set[str]:
+    """Local names assigned from jnp.* / jax.* calls."""
+    tainted: set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            name = call_name(node.value.func)
+            if name.startswith(("jnp.", "jax.")):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        tainted.add(tgt.id)
+                    elif isinstance(tgt, ast.Tuple):
+                        tainted.update(
+                            e.id for e in tgt.elts if isinstance(e, ast.Name)
+                        )
+    return tainted
+
+
+@rule(
+    "readback",
+    "device→host syncs outside the sanctioned readback layer (executor/, parallel/)",
+)
+def check_readback(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        if any(s in f.rel for s in SANCTIONED_PREFIXES) or any(
+            f.rel.startswith(p.split("pilosa_tpu/")[1])
+            for p in SANCTIONED_PREFIXES
+        ):
+            continue
+        if not f.imports_module("jax", "jax.numpy"):
+            continue
+        # function scopes first (their own taint sets), then the module
+        # scope for top-level code; the seen-set keeps nested nodes from
+        # double-reporting when the module walk revisits function bodies
+        scopes = list(functions(f.tree)) + [f.tree]
+        seen: set[int] = set()
+        for fn in scopes:
+            tainted = _taint(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                seen.add(id(node))
+                name = call_name(node.func)
+                short = name.rsplit(".", 1)[-1]
+                if short in _ALWAYS_SYNC:
+                    out.append(
+                        Violation(
+                            "readback",
+                            f.rel,
+                            node.lineno,
+                            f"{short}() forces a device sync outside the "
+                            "readback layer — return the device value and "
+                            "let the executor's readback wave fetch it",
+                        )
+                    )
+                    continue
+                if name == "jax.device_get":
+                    out.append(
+                        Violation(
+                            "readback",
+                            f.rel,
+                            node.lineno,
+                            "jax.device_get() outside the readback layer — "
+                            "route the fetch through the executor",
+                        )
+                    )
+                    continue
+                is_coerce = name in _COERCE_CALLS or (
+                    name in _COERCE_BUILTINS and len(node.args) == 1
+                )
+                if is_coerce and node.args and _is_device_expr(
+                    node.args[0], tainted
+                ):
+                    out.append(
+                        Violation(
+                            "readback",
+                            f.rel,
+                            node.lineno,
+                            f"{name or short}() on a JAX value forces a "
+                            "device sync outside the readback layer",
+                        )
+                    )
+                elif short == "item" and not node.args and _is_device_expr(
+                    node.func, tainted
+                ):
+                    out.append(
+                        Violation(
+                            "readback",
+                            f.rel,
+                            node.lineno,
+                            ".item() on a JAX value forces a device sync "
+                            "outside the readback layer",
+                        )
+                    )
+    return out
