@@ -634,6 +634,218 @@ def config7_cluster_read():
          qps_cluster / qps_single, extra=extra)
 
 
+def config8_concurrency_sweep():
+    """ISSUE 4: sync Count/TopN/GroupBy QPS swept over REAL concurrent
+    HTTP clients (c1/c8/c32) against one server with cross-query wave
+    coalescing on — the production shape (N dashboards, each sync) that
+    the pipelined rows cannot represent. Clients issue identical
+    queries (the dashboard case: single-flight dedup + shared readback
+    waves are exactly what the scheduler ships). The server pins
+    route-mode=device: the sweep measures the device wave path — host-
+    routed work bypasses the scheduler by design, so sweeping it would
+    measure host thread scaling instead. Also emits the c1 p50
+    adaptive-vs-off latency ratio (the batching-never-hurts-solo guard)
+    and queries_per_wave_p50. Exits non-zero if c8 < c1 for any call
+    type: batching must never regress the solo path."""
+    import sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils.config import Config
+
+    rng = np.random.default_rng(8)
+    shards = int(os.environ.get("PILOSA_BENCH_SWEEP_SHARDS", "8"))
+    n = shards * SHARD_WIDTH
+    port = free_ports(1)[0]
+    srv = Server(
+        Config(
+            bind=f"127.0.0.1:{port}",
+            data_dir=tempfile.mkdtemp(),
+            route_mode="device",
+            batch_mode="adaptive",
+            # bench-only: bulk-load the sweep index in few POSTs
+            max_writes_per_request=500_000,
+        )
+    )
+    srv.open()
+    srv.wait_mesh(60)
+    try:
+
+        def post(path, payload):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}{path}",
+                data=json.dumps(payload).encode(),
+                method="POST",
+            )
+            urllib.request.urlopen(req).read()
+
+        def query(body: bytes):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/sw/query",
+                data=body,
+                method="POST",
+            )
+            with urllib.request.urlopen(req) as r:
+                return json.loads(r.read())
+
+        post("/index/sw", {})
+        post("/index/sw/field/cab", {})
+        post("/index/sw/field/pc", {})
+        cols = np.arange(n, dtype=np.uint64)
+        cab_rows = rng.integers(0, 256, n).astype(np.uint64)
+        pc_rows = rng.integers(1, 7, n).astype(np.uint64)
+        for lo in range(0, n, 400_000):
+            post(
+                "/index/sw/field/cab/import",
+                {
+                    "rowIDs": cab_rows[lo : lo + 400_000].tolist(),
+                    "columnIDs": cols[lo : lo + 400_000].tolist(),
+                },
+            )
+            post(
+                "/index/sw/field/pc/import",
+                {
+                    "rowIDs": pc_rows[lo : lo + 400_000].tolist(),
+                    "columnIDs": cols[lo : lo + 400_000].tolist(),
+                },
+            )
+
+        # representative dashboard queries: enough device work that the
+        # sweep measures wave sharing, not Python HTTP parsing (XLA
+        # releases the GIL, so waves overlap the next batch's request
+        # handling; a trivially cheap query would measure the handler)
+        queries = {
+            "count": (
+                b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
+                b" Row(cab=4), Row(cab=5), Row(cab=6)))"
+            ),
+            "topn": b"TopN(cab, n=10)",
+            "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+        }
+        iters = int(os.environ.get("PILOSA_BENCH_SWEEP_ITERS", "30"))
+
+        def agg_qps(body: bytes, conc: int, per: int) -> float:
+            import http.client
+
+            barrier = threading.Barrier(conc + 1)
+            errors: list = []
+
+            def client():
+                # one persistent (keep-alive) connection per client —
+                # real clients don't reconnect per query, and a c32
+                # connect storm would measure the TCP stack, not the
+                # server
+                conn = http.client.HTTPConnection("127.0.0.1", port)
+                barrier.wait()
+                try:
+                    for _ in range(per):
+                        conn.request("POST", "/index/sw/query", body)
+                        resp = conn.getresponse()
+                        payload = resp.read()
+                        if resp.status != 200:
+                            raise RuntimeError(
+                                f"HTTP {resp.status}: {payload[:200]!r}"
+                            )
+                except Exception as exc:  # noqa: BLE001 — re-raised below
+                    errors.append(exc)
+                finally:
+                    conn.close()
+
+            ts = [
+                threading.Thread(target=client, daemon=True)
+                for _ in range(conc)
+            ]
+            for t in ts:
+                t.start()
+            barrier.wait()
+            t0 = time.perf_counter()
+            for t in ts:
+                t.join()
+            dt = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            return conc * per / dt
+
+        # c1 p50 latency, batching off vs adaptive: the solo-path guard
+        # (acceptance: adaptive within 10% of off at c1). Both modes
+        # warm the same compiled programs first so jit caching never
+        # biases whichever mode measures first.
+        def c1_p50_ms(body: bytes) -> float:
+            lats = []
+            for _ in range(max(20, iters)):
+                t0 = time.perf_counter()
+                query(body)
+                lats.append(time.perf_counter() - t0)
+            return sorted(lats)[len(lats) // 2] * 1e3
+
+        for mode in ("off", "adaptive"):
+            srv.api.scheduler.mode = mode
+            for _ in range(3):
+                query(queries["topn"])
+        srv.api.scheduler.mode = "off"
+        off_p50 = c1_p50_ms(queries["topn"])
+        srv.api.scheduler.mode = "adaptive"
+        on_p50 = c1_p50_ms(queries["topn"])
+        ratio = on_p50 / max(off_p50, 1e-9)
+        line(
+            "sync_c1_topn_p50_adaptive_vs_off",
+            ratio,
+            "ratio",
+            1.0,
+            extra={"off_p50_ms": round(off_p50, 3), "on_p50_ms": round(on_p50, 3)},
+        )
+        failed = False
+        if ratio > 1.10:
+            # the solo-path guard is a GATE, not a datapoint: adaptive
+            # batching adding >10% to c1 p50 is the regression the
+            # acceptance criterion forbids
+            failed = True
+            line(
+                "batching_regressed_c1_latency",
+                ratio,
+                "error",
+                ratio,
+            )
+        for name, body in queries.items():
+            query(body)  # warm the program cache
+            rates = {}
+            for conc in (1, 8, 32):
+                per = max(2, iters // conc) if conc > 1 else iters
+                rates[conc] = agg_qps(body, conc, per)
+            for conc in (1, 8, 32):
+                line(
+                    f"sync_{name}_qps_c{conc}",
+                    rates[conc],
+                    "qps",
+                    rates[conc] / max(rates[1], 1e-9),
+                )
+            if rates[8] < rates[1]:
+                failed = True
+                line(
+                    f"batching_regressed_{name}_c8_below_c1",
+                    rates[8] / max(rates[1], 1e-9),
+                    "error",
+                    rates[8] / max(rates[1], 1e-9),
+                )
+        qpw = srv.stats.distribution("queries_per_wave")
+        line(
+            "queries_per_wave_p50",
+            qpw.percentile(0.5) if qpw is not None else 1.0,
+            "queries",
+            1.0,
+            extra={
+                "queryBatching": srv.api.scheduler.snapshot(),
+            },
+        )
+    finally:
+        srv.close()
+    if failed:
+        sys.exit(1)
+
+
 def transport_context(emit: bool = True):
     """The sync dispatch+readback RTT floor. On a tunneled (remote)
     accelerator every SYNC query pays this regardless of device work, so
@@ -666,6 +878,7 @@ CONFIGS = {
     "5": config5_tanimoto,
     "6": config6_ingest,
     "7": config7_cluster_read,
+    "8": config8_concurrency_sweep,
 }
 
 

@@ -1,6 +1,14 @@
-"""L2 query execution (reference: executor.go, row.go)."""
+"""L2 query execution (reference: executor.go, row.go; the cross-query
+wave scheduler is this repo's addition — docs/query-batching.md)."""
 
 from pilosa_tpu.executor.executor import ExecutionError, Executor, SumCount
 from pilosa_tpu.executor.row import RowResult
+from pilosa_tpu.executor.scheduler import WaveScheduler
 
-__all__ = ["Executor", "ExecutionError", "RowResult", "SumCount"]
+__all__ = [
+    "Executor",
+    "ExecutionError",
+    "RowResult",
+    "SumCount",
+    "WaveScheduler",
+]

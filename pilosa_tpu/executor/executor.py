@@ -115,6 +115,13 @@ class ExecutionError(ValueError):
     pass
 
 
+def finalize(results: list) -> list:
+    """Dispatched results → client-facing values (resolved pendings
+    replaced by their finished values). Shared by Executor.execute and
+    the wave scheduler's per-query completion."""
+    return [r.value if isinstance(r, _Pending) else r for r in results]
+
+
 class _Pending:
     """Deferred on-device aggregate values. execute() resolves EVERY
     pending result in one readback wave after all calls have dispatched:
@@ -122,18 +129,31 @@ class _Pending:
     and fetched with a single device→host transfer — an N-aggregate
     request pays one transport RTT, not N (VERDICT r3 weak #3: with only
     Count pipelined, sync TopN ran at ~1/RTT and GroupBy below the CPU
-    baseline). `finish` turns the fetched host arrays (original shapes)
-    into the final result."""
+    baseline). The same mechanism settles CROSS-QUERY waves: the
+    dispatch scheduler (executor/scheduler.py) concatenates pendings
+    from many concurrent requests into one transfer. `finish` turns the
+    fetched host arrays (original shapes) into the final result;
+    ``fetched`` holds them between the transfer (scheduler.fetch_wave)
+    and the per-query resolve so one query's finish() failure cannot
+    strand its wave-mates."""
 
-    __slots__ = ("arrays", "finish", "value")
+    __slots__ = ("arrays", "finish", "value", "fetched")
 
     def __init__(self, arrays: list, finish: "Callable[[list], Any]") -> None:
         self.arrays = list(arrays)
         self.finish = finish
         self.value = None
+        self.fetched: list | None = None
 
     def resolve_now(self) -> Any:
         self.value = self.finish([np.asarray(a) for a in self.arrays])
+        return self.value
+
+    def resolve_fetched(self) -> Any:
+        """Finish from host arrays a prior fetch_wave stored — no device
+        access; safe to call per query with per-query error isolation."""
+        assert self.fetched is not None, "resolve_fetched before fetch"
+        self.value = self.finish(self.fetched)
         return self.value
 
 
@@ -231,24 +251,52 @@ class Executor:
         index_name: str,
         query: str | list[Call],
         shards: list[int] | None = None,
+        routes: "list[tuple[str | None, int]] | None" = None,
     ) -> list[Any]:
+        results = self.dispatch(index_name, query, shards, routes=routes)
+        pending = [r for r in results if isinstance(r, _Pending)]
+        if pending:
+            elapsed = self.settle(pending)
+            prof = tracing.current_profile()
+            if prof is not None:
+                # the one device→host sync the whole request pays; on a
+                # tunneled accelerator this line IS the latency story
+                prof.add_call("_readback", elapsed, None)
+        return finalize(results)
+
+    def dispatch(
+        self,
+        index_name: str,
+        query: str | list[Call],
+        shards: list[int] | None = None,
+        routes: "list[tuple[str | None, int]] | None" = None,
+    ) -> list[Any]:
+        """Issue every call WITHOUT the readback wave — aggregates come
+        back as unresolved ``_Pending``s. This is the enqueue half the
+        cross-query scheduler shares: a wave dispatches many queries
+        through here, then settles ALL their pendings in one transfer
+        (settle / scheduler.fetch_wave). Aggregates dispatch ASYNC
+        (device arrays, not yet synced) in program order, so an
+        aggregate preceding a write still reads pre-write state —
+        exactly the sequential semantics. Per-call dispatch is spanned +
+        histogram-timed (the readback wave is timed separately:
+        pipelining means a call's device time is not attributable to its
+        own dispatch).  ``routes`` optionally carries per-call
+        ``(route, work)`` pairs a caller (the wave scheduler's
+        batchability check) already computed, so the hot path doesn't
+        pay the work estimation twice."""
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index {index_name!r} not found")
         calls = parse(query) if isinstance(query, str) else query
-        # Aggregates dispatch ASYNC (device arrays, not yet synced) and
-        # resolve together after every call has dispatched. Dispatch
-        # order is program order, so an aggregate preceding a write still
-        # reads pre-write state — exactly the sequential semantics.
-        # Per-call dispatch is spanned + histogram-timed (the readback
-        # wave is timed separately below: pipelining means a call's
-        # device time is not attributable to its own dispatch).
         prof = tracing.current_profile()
         prof_shards: list[int] | None = None
         results = []
-        for c in calls:
+        for i, c in enumerate(calls):
             t0 = time.perf_counter()
-            route, work = self._route(idx, c, shards)
+            route, work = (
+                routes[i] if routes is not None else self._route(idx, c, shards)
+            )
             with GLOBAL_TRACER.span(f"executor.{c.name}", index=index_name):
                 results.append(
                     self._execute_call(idx, c, shards, lazy=True, route=route)
@@ -270,36 +318,34 @@ class Executor:
                 if prof_shards is None:
                     prof_shards = self._shards(idx, shards)
                 prof.add_call(c.name, elapsed, prof_shards, route=route)
-        pending = [r for r in results if isinstance(r, _Pending)]
-        if pending:
-            t0 = time.perf_counter()
-            flat = [
-                jnp.ravel(a).astype(jnp.int64) for p in pending for a in p.arrays
-            ]
-            if len(flat) == 1:
-                host = [np.asarray(flat[0])]
-            else:
-                joined = np.asarray(jnp.concatenate(flat))
-                host, off = [], 0
-                for a in flat:
-                    host.append(joined[off : off + a.size])
-                    off += a.size
-            i = 0
-            for p in pending:
-                args = []
-                for a in p.arrays:
-                    args.append(host[i].reshape(np.shape(a)))
-                    i += 1
-                p.value = p.finish(args)
-            elapsed = time.perf_counter() - t0
-            self.router.observe_readback(elapsed)
-            if self.stats is not None:
-                self.stats.timing("executor_readback_seconds", elapsed)
-            if prof is not None:
-                # the one device→host sync the whole request pays; on a
-                # tunneled accelerator this line IS the latency story
-                prof.add_call("_readback", elapsed, None)
-        return [r.value if isinstance(r, _Pending) else r for r in results]
+        return results
+
+    def fetch(self, pending: "list[_Pending]") -> float:
+        """One device→host transfer for every pending's arrays (the
+        settlement layer lives in executor/scheduler.py — fetch_wave is
+        the ONLY sanctioned readback site, per the readback analyzer
+        rule). Leaves each pending's host arrays on ``p.fetched``;
+        callers resolve per query so one finish() failure can't poison
+        wave-mates. Records the readback histogram + router calibration."""
+        if not pending:
+            return 0.0
+        from pilosa_tpu.executor.scheduler import fetch_wave
+
+        t0 = time.perf_counter()
+        fetch_wave(pending)
+        elapsed = time.perf_counter() - t0
+        self.router.observe_readback(elapsed)
+        if self.stats is not None:
+            self.stats.timing("executor_readback_seconds", elapsed)
+        return elapsed
+
+    def settle(self, pending: "list[_Pending]") -> float:
+        """Fetch + resolve a pending set (one query's, or a whole wave's
+        when the caller doesn't need per-query error isolation)."""
+        elapsed = self.fetch(pending)
+        for p in pending:
+            p.resolve_fetched()
+        return elapsed
 
     def _shards(self, idx: Index, shards: list[int] | None) -> list[int]:
         if shards is not None:

@@ -98,6 +98,14 @@ class QueryRouter:
         # query cannot flip every subsequent routing decision
         self._dispatch_hist = Histogram()
         self._readback_hist = Histogram()
+        # cross-query wave occupancy (executor/scheduler.py feeds it):
+        # when concurrent sync queries share readback waves, the per-
+        # query device overhead is the wave total divided by occupancy —
+        # without this the cost model keeps charging every query a full
+        # dispatch+readback and over-routes to the host exactly when the
+        # device path got cheap. Seeded at 1.0 (no sharing), so solo
+        # traffic and batch-mode=off see the unamortized model unchanged.
+        self.wave_occupancy = Ewma(alpha, 1.0)
         self.crossover_override = float(crossover_words)
         self._lock = threading.Lock()
         self._memo: dict[tuple, tuple[int, str]] = {}
@@ -109,6 +117,7 @@ class QueryRouter:
             "dispatch": self.dispatch_s.value,
             "readback": self.readback_s.value,
             "host_overhead": self.host_overhead_s.value,
+            "wave_occupancy": self.wave_occupancy.value,
         }
         if self.host_wps.value is not None:
             self._snapshots["host_wps"] = self.host_wps.value
@@ -176,6 +185,16 @@ class QueryRouter:
             )
         self._bump_observes()
 
+    def observe_wave(self, queries: int) -> None:
+        """Fold one wave's occupancy (queries sharing a readback) into
+        the model; >25% drift re-evaluates memoized route decisions the
+        same way a dispatch/readback move does."""
+        if queries < 1:
+            return
+        self._note_drift(
+            "wave_occupancy", self.wave_occupancy.update(float(queries))
+        )
+
     def observe_readback(self, seconds: float) -> None:
         if seconds <= 0:
             return
@@ -223,9 +242,18 @@ class QueryRouter:
         return self.host_overhead_s.value + work_words / self._host_wps()
 
     def device_cost(self, work_words: float) -> float:
+        # batch-aware: the wave scheduler shares ONE readback across a
+        # wave, so the per-query readback cost is the wave total over
+        # occupancy. Dispatch is NOT amortized — wave-mates' dispatches
+        # issue serially on the leader thread, so each query still pays
+        # its own (dividing it too would undercharge the device path
+        # under load and flip small host-cheap queries back to the
+        # device — the r05 0.04x shape). Occupancy 1 (solo traffic,
+        # batch-mode off) reduces to the plain model.
+        occ = max(1.0, self.wave_occupancy.value or 1.0)
         return (
             self.dispatch_s.value
-            + self.readback_s.value
+            + self.readback_s.value / occ
             + work_words / self.device_wps
         )
 
@@ -234,9 +262,10 @@ class QueryRouter:
         crossover the profile/debug surfaces report."""
         if self.crossover_override > 0:
             return self.crossover_override
+        occ = max(1.0, self.wave_occupancy.value or 1.0)
         overhead = (
             self.dispatch_s.value
-            + self.readback_s.value
+            + self.readback_s.value / occ
             - self.host_overhead_s.value
         )
         per_word = 1.0 / self._host_wps() - 1.0 / self.device_wps
@@ -292,6 +321,7 @@ class QueryRouter:
             "hostOverheadSeconds": self.host_overhead_s.value,
             "hostWordsPerSecond": self.host_wps.value,
             "deviceWordsPerSecond": self.device_wps,
+            "waveOccupancy": self.wave_occupancy.value,
             "decisions": dict(self.decisions),
         }
 

@@ -119,6 +119,37 @@ class InternalClient:
             return [decode_result(d, blobs) for d in control["results"]]
         return [decode_result(d) for d in json.loads(raw)["results"]]
 
+    def query_batch_node(self, uri: str, entries: list[dict]) -> list:
+        """Several fan-out legs to ONE peer as a single multi-query RPC
+        (POST /internal/query/batch) — the cross-query wave scheduler's
+        cluster half: wave-mates targeting the same remote node pay one
+        HTTP round trip instead of one each.  Each entry carries its own
+        ``traceId``/``parentSpanId`` (one request cannot carry N header
+        contexts), so per-query trace propagation survives coalescing.
+        Returns one element per entry: the decoded result list, or a
+        PeerError instance for entries the peer failed (per-entry error
+        isolation — one bad query must not fail its RPC-mates)."""
+        from pilosa_tpu.encoding import frame
+        from pilosa_tpu.parallel.resultwire import decode_result
+
+        raw = self._request(
+            "POST",
+            uri,
+            "/internal/query/batch",
+            json.dumps({"queries": entries}).encode(),
+        )
+        if frame.is_frame(raw):
+            control, blobs = frame.decode_frame(raw)
+        else:
+            control, blobs = json.loads(raw), []
+        out: list = []
+        for ent in control["queries"]:
+            if "error" in ent:
+                out.append(PeerError(uri, ent["error"]))
+            else:
+                out.append([decode_result(d, blobs) for d in ent["results"]])
+        return out
+
     def fetch_trace(self, uri: str, trace_id: str) -> list[dict]:
         """One trace's spans buffered on a peer (GET /internal/trace) —
         the coordinator stitches them under its own spans for export."""

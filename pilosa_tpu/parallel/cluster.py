@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from typing import Any
 
 import numpy as np
@@ -57,6 +58,206 @@ from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 HEARTBEAT_INTERVAL = 2.0
 
 
+class _Leg:
+    """One fan-out leg awaiting a (possibly shared) RPC."""
+
+    __slots__ = (
+        "index",
+        "pql",
+        "shards",
+        "ctx",
+        "done",
+        "results",
+        "error",
+        "bytes",
+    )
+
+    def __init__(self, index: str, pql: str, shards, ctx):
+        self.index = index
+        self.pql = pql
+        self.shards = shards
+        self.ctx = ctx  # (trace_id, span_id) of the submitting thread
+        self.done = threading.Event()
+        self.results: list | None = None
+        self.error: BaseException | None = None
+        # this leg's share of the (possibly shared) RPC response bytes,
+        # handed back to the SUBMITTER's profile — the sender thread's
+        # profile must not swallow the whole envelope's bytes
+        self.bytes = 0
+
+
+class _NodeLegBatcher:
+    """Coalesce concurrent fan-out legs to the SAME peer into one
+    multi-query ``POST /internal/query/batch`` — the cluster half of
+    cross-query wave coalescing (docs/query-batching.md): when the wave
+    scheduler (or simply N concurrent coordinator threads) produces
+    several legs for one remote node, they ride one HTTP round trip and
+    the remote node settles them in one device readback wave.
+
+    Group-commit only, no timed window: a solo leg goes out immediately
+    on the plain single-query RPC (identical wire behavior to the
+    pre-batching path), and legs that arrive while a peer's sender is
+    busy form the next batch.  Sender duty uses the same
+    contend-and-handoff protocol as ``WaveScheduler._await``: a sender
+    ships exactly ONE batch and then releases duty so the next waiting
+    caller takes over — no caller keeps pumping other threads' batches
+    after its own answer landed, and because every transition (enqueue,
+    duty claim/release, completion) happens under one condition
+    variable, a crashed sender can neither leak the duty flag nor
+    strand queued legs.  Per-leg trace context travels in the request
+    body; per-leg failures come back as per-entry errors so one bad
+    query never fails its RPC-mates."""
+
+    MAX_LEGS = 64
+
+    def __init__(self, cluster: "Cluster"):
+        self.cluster = cluster
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: dict[str, deque[_Leg]] = {}
+        self._busy: set[str] = set()
+
+    def call(self, node: "Node", index: str, pql: str, shards) -> list:
+        leg = _Leg(index, pql, shards, GLOBAL_TRACER.current_context())
+        if getattr(self.cluster.config, "batch_mode", "adaptive") == "off":
+            # no coalescing: one solo-leg send, still spanned + timed
+            self._send(node, [leg])
+            self._credit_bytes(leg)
+            if leg.error is not None:
+                raise leg.error
+            return leg.results  # type: ignore[return-value]
+        uri = node.uri
+        with self._cond:
+            self._pending.setdefault(uri, deque()).append(leg)
+            self._cond.notify_all()
+        while True:
+            with self._cond:
+                while not leg.done.is_set() and (
+                    uri in self._busy or not self._pending.get(uri)
+                ):
+                    self._cond.wait()
+                if leg.done.is_set():
+                    break
+                self._busy.add(uri)
+            try:
+                self._drain_one(node)
+            finally:
+                with self._cond:
+                    self._busy.discard(uri)
+                    self._cond.notify_all()
+        self._credit_bytes(leg)
+        if leg.error is not None:
+            raise leg.error
+        return leg.results  # type: ignore[return-value]
+
+    @staticmethod
+    def _credit_bytes(leg: _Leg) -> None:
+        """Report this leg's RPC-byte share to the SUBMITTER's profile
+        (the shared RPC was read on whichever thread held sender duty,
+        so the client's automatic accounting landed there instead)."""
+        prof = tracing.current_profile()
+        if prof is not None:
+            prof.note_rpc_bytes(leg.bytes)
+
+    def _drain_one(self, node: "Node") -> None:
+        """Ship ONE batch of queued legs (sender duty for a single
+        round trip; the caller releases duty afterwards)."""
+        with self._cond:
+            q = self._pending.get(node.uri)
+            if not q:
+                return
+            legs: list[_Leg] = []
+            while q and len(legs) < self.MAX_LEGS:
+                legs.append(q.popleft())
+        try:
+            self._send(node, legs)
+        finally:
+            for leg in legs:  # transport-level failure: fail every
+                # leg of THIS rpc (per-query isolation is the
+                # receiver's job; a dead socket has no per-query story)
+                if not leg.done.is_set():
+                    if leg.error is None and leg.results is None:
+                        leg.error = PeerError(
+                            node.uri, "batched query RPC aborted"
+                        )
+                    leg.done.set()
+            with self._cond:
+                self._cond.notify_all()
+
+    def _send(self, node: "Node", legs: list[_Leg]) -> None:
+        client = self.cluster.client
+        stats = self.cluster.server.stats
+        t0 = time.perf_counter()
+        # scratch profile: the internal client notes response bytes
+        # into the CALLING thread's collector — capture them here and
+        # split evenly across the envelope's legs, so each submitter's
+        # ?profile=true sees its share instead of the sender's profile
+        # swallowing everything (see _credit_bytes)
+        scratch = tracing.QueryProfile()
+        with GLOBAL_TRACER.span(
+            "cluster.fanout_batch", node=node.id, legs=len(legs)
+        ):
+            try:
+                if len(legs) == 1:
+                    leg = legs[0]
+                    ctx = leg.ctx or (None, None)
+                    # solo leg: the plain RPC, under the LEG's trace
+                    # context (the sender may be draining another
+                    # thread's leg)
+                    with GLOBAL_TRACER.detached(ctx[0], ctx[1]):
+                        with tracing.use_profile(scratch):
+                            leg.results = client.query_node(
+                                node.uri, leg.index, leg.pql, leg.shards
+                            )
+                    leg.bytes = scratch.take_rpc_bytes()
+                    leg.done.set()
+                else:
+                    entries = [
+                        {
+                            "index": leg.index,
+                            "query": leg.pql,
+                            "shards": leg.shards,
+                            "traceId": (leg.ctx or (None, None))[0],
+                            "parentSpanId": (leg.ctx or (None, None))[1],
+                        }
+                        for leg in legs
+                    ]
+                    with tracing.use_profile(scratch):
+                        outs = client.query_batch_node(node.uri, entries)
+                    share = scratch.take_rpc_bytes() // len(legs)
+                    for leg, out in zip(legs, outs):
+                        leg.bytes = share
+                        if isinstance(out, Exception):
+                            leg.error = out
+                        else:
+                            leg.results = out
+                        leg.done.set()
+            except Exception as e:  # noqa: BLE001 — ANY send/decode
+                # failure (transport, malformed peer reply, version
+                # skew) fails this RPC's legs and keeps the drain loop
+                # pumping; letting it propagate would strand the legs
+                # still queued behind it
+                err = e if isinstance(e, PeerError) else PeerError(
+                    node.uri, f"batched query RPC failed: {e!r}"
+                )
+                for leg in legs:
+                    if not leg.done.is_set():
+                        leg.error = err
+                        leg.done.set()
+        if stats is not None and len(legs) > 1:
+            # only genuinely COALESCED envelopes: a solo leg is the
+            # plain single-query RPC, already timed as its caller's
+            # fanout_rpc_seconds — counting it here would both
+            # double-time it and drag legs_per_batch_rpc toward 1,
+            # misreading mostly-solo traffic as broken coalescing
+            stats.timing(
+                "fanout_batch_rpc_seconds",
+                time.perf_counter() - t0,
+                tags={"node": node.id},
+            )
+            stats.observe("legs_per_batch_rpc", float(len(legs)))
+
+
 class Cluster:
     # TopN iterative-deepening rounds before the bounded minCount sweep
     # (up to 256× the initial headroom). Class attr so tests can force
@@ -67,6 +268,10 @@ class Cluster:
         self.server = server
         self.config = server.config
         self.client = InternalClient(skip_verify=self.config.tls_skip_verify)
+        # per-peer fan-out leg coalescer: concurrent legs to one node
+        # share a multi-query /internal/query/batch RPC (batch-mode=off
+        # restores the one-RPC-per-leg path)
+        self._legs = _NodeLegBatcher(self)
         me = Node(
             id=self.config.node_id,
             uri=server.uri,
@@ -1049,15 +1254,18 @@ class Cluster:
     ) -> tuple[list[Any], float]:
         """One fan-out RPC leg with the observability contract applied
         in ONE place: a tracing span + the ``fanout_rpc_seconds``
-        histogram (the analyzer's observability rule keys on exactly
-        this pairing around ``client.query_node``).  Returns (decoded
-        results, elapsed seconds); a failed leg raises before the
-        histogram records, same as before extraction."""
+        histogram.  The RPC itself goes through the per-peer leg
+        coalescer (``_NodeLegBatcher``) so concurrent legs to the same
+        node share one multi-query /internal RPC; this span therefore
+        covers queue wait + the (possibly shared) round trip — per-leg
+        latency as the CALLER experienced it.  Returns (decoded results,
+        elapsed seconds); a failed leg raises before the histogram
+        records, same as before extraction."""
         t0 = time.perf_counter()
         with GLOBAL_TRACER.span(
             span_name, node=node.id, shards=len(shards) if shards else 0
         ):
-            result = self.client.query_node(node.uri, index, pql, shards)
+            result = self._legs.call(node, index, pql, shards)
         elapsed = time.perf_counter() - t0
         if self.server.stats is not None:
             self.server.stats.timing(
@@ -1083,14 +1291,16 @@ class Cluster:
             t0 = time.perf_counter()
             if node_id == self.me.id:
                 # this node serves its own shard group — counts toward
-                # the per-node replica read spread (see _h_query)
+                # the per-node replica read spread (see _h_query). Via
+                # the wave scheduler: concurrent coordinator threads'
+                # local legs coalesce into shared device waves.
                 if stats is not None:
                     stats.count("queries_served", tags={"path": "local"})
                 with GLOBAL_TRACER.span(
                     "cluster.local", node=node_id, shards=len(node_shards)
                 ):
                     partials.extend(
-                        self.server.api.executor.execute(
+                        self.server.api.scheduler.execute(
                             index, [call], shards=node_shards
                         )
                     )
@@ -2208,6 +2418,7 @@ class Cluster:
         http = self.server.http
         routes = {
             ("POST", re.compile(r"^/internal/query$")): self._h_query,
+            ("POST", re.compile(r"^/internal/query/batch$")): self._h_query_batch,
             ("GET", re.compile(r"^/internal/shards$")): self._h_shards,
             ("GET", re.compile(r"^/internal/fragment/blocks$")): self._h_blocks,
             ("GET", re.compile(r"^/internal/fragment/block/data$")): self._h_block_data,
@@ -2282,7 +2493,10 @@ class Cluster:
         # served locally (the _fanout local branch) — counts once, so
         # the cluster-wide distribution shows the replica read spread
         self.server.stats.count("queries_served", tags={"path": "remote"})
-        results = self.server.api.executor.execute(
+        # through the wave scheduler: concurrent remote legs from
+        # different coordinators (or wave-mates) share this node's
+        # device dispatch/readback waves exactly like client queries
+        results = self.server.api.scheduler.execute(
             body["index"], body["query"], shards=body.get("shards")
         )
         # framed response: JSON control + raw packed-word blobs — a wide
@@ -2292,6 +2506,48 @@ class Cluster:
         blobs: list[bytes] = []
         control = {"results": [encode_result(r, blobs) for r in results]}
         handler._bytes(frame.encode_frame(control, blobs), frame.CONTENT_TYPE)
+
+    def _h_query_batch(self, handler) -> None:
+        """Multi-query /internal RPC: several coordinator fan-out legs
+        coalesced into one POST (``_NodeLegBatcher``).  Per-entry trace
+        context rides in the body — one HTTP request cannot carry N
+        header contexts — and each entry's execution joins its own
+        propagated trace via the scheduler's detached per-query spans.
+        The whole batch goes to the wave scheduler as ONE enqueue
+        (``execute_many``), so the legs also share this node's device
+        readback wave.  Per-entry error isolation: a failing query
+        yields an ``error`` entry; its RPC-mates answer normally."""
+        body = handler._json_body()
+        if not self.server._query_gate(wait=False):
+            raise ShardUnavailableError(
+                "device probe in progress on this node; retry"
+            )
+        entries = body.get("queries", [])
+        stats = self.server.stats
+        reqs = []
+        for q in entries:
+            stats.count("queries_served", tags={"path": "remote"})
+            reqs.append(
+                (
+                    q["index"],
+                    q["query"],
+                    q.get("shards"),
+                    (q.get("traceId"), q.get("parentSpanId")),
+                )
+            )
+        with GLOBAL_TRACER.span("cluster.query_batch", queries=len(entries)):
+            with stats.timer("internal_query_batch_seconds"):
+                results = self.server.api.scheduler.execute_many(reqs)
+        blobs: list[bytes] = []
+        out: list[dict] = []
+        for r in results:
+            if isinstance(r, BaseException):
+                out.append({"error": str(r)})
+            else:
+                out.append({"results": [encode_result(x, blobs) for x in r]})
+        handler._bytes(
+            frame.encode_frame({"queries": out}, blobs), frame.CONTENT_TYPE
+        )
 
     def _h_trace(self, handler) -> None:
         """One trace's locally buffered spans (the stitch half of

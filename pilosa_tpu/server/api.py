@@ -87,6 +87,9 @@ class API:
         mesh_ctx=None,
         max_writes: int = 5000,
         router=None,
+        batch_mode: str | None = None,
+        batch_window_us: float | None = None,
+        batch_max_queries: int | None = None,
     ):
         self.holder = holder
         self.cluster = cluster  # None ⇒ single-node
@@ -105,6 +108,20 @@ class API:
         self.stats = stats
         self.executor = Executor(
             holder, mesh_ctx=mesh_ctx, stats=stats, router=router
+        )
+        # cross-query wave scheduler (executor/scheduler.py): sync
+        # queries submitted concurrently share device dispatch/readback
+        # waves. Bound to a GETTER, not the executor instance, so the
+        # late mesh attach (attach_mesh swaps the Executor) never
+        # strands queued queries on a dead engine.
+        from pilosa_tpu.executor.scheduler import WaveScheduler
+
+        self.scheduler = WaveScheduler(
+            lambda: self.executor,
+            stats=stats,
+            mode=batch_mode,
+            window_us=batch_window_us,
+            max_queries=batch_max_queries,
         )
         self.diagnostics = None  # set by Server.open
 
@@ -209,7 +226,11 @@ class API:
             # single-node served-query counter; clustered serving counts
             # per fan-out leg in parallel/cluster.py instead
             self.stats.count("queries_served", tags={"path": "local"})
-        results = self.executor.execute(index, calls, shards=shards)
+        # sync queries go to the wave scheduler, not straight to
+        # execute: concurrent device-routed requests coalesce into
+        # shared dispatch/readback waves (writes and host-routed reads
+        # pass through direct — see executor/scheduler.py)
+        results = self.scheduler.execute(index, calls, shards=shards)
         return self.build_response(results)
 
     def build_response(self, results: list[Any]) -> dict:

@@ -470,6 +470,9 @@ class Handler(BaseHTTPRequestHandler):
         # live cost-router calibration: mode, crossover, and the EWMAs
         # behind every host/device decision (docs/query-routing.md)
         out["queryRouting"] = self.api.executor.router.snapshot()
+        # cross-query wave coalescing: waves, occupancy, dedup hits
+        # (docs/query-batching.md)
+        out["queryBatching"] = self.api.scheduler.snapshot()
         self._json(out)
 
     def h_debug_traces(self) -> None:
@@ -582,6 +585,10 @@ class HTTPServer(ThreadingHTTPServer):
     """
 
     daemon_threads = True
+    # the socketserver default backlog (5) resets connections under a
+    # burst of concurrent clients — exactly the many-sync-users shape
+    # the wave scheduler serves; size it for a connect storm instead
+    request_queue_size = 128
 
     def handle_error(self, request, client_address):
         import sys
@@ -624,6 +631,10 @@ class HTTPServer(ThreadingHTTPServer):
 
         self.log = Logger().log
         self.extra_routes: dict = {}
+        # sync queries land in the API façade, which hands them to the
+        # cross-query wave scheduler (api.scheduler) instead of calling
+        # the executor directly — concurrent clients share device
+        # dispatch/readback waves (docs/query-batching.md)
         self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
         self.import_router = self._local_import
         # cluster layer swaps this for a primary-forwarding version — ID

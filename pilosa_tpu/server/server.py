@@ -64,6 +64,9 @@ class Server:
             mesh_ctx=None,
             max_writes=self.config.max_writes_per_request,
             router=router,
+            batch_mode=self.config.batch_mode,
+            batch_window_us=self.config.batch_window_us,
+            batch_max_queries=self.config.batch_max_queries,
         )
         self.http: HTTPServer | None = None
         self.diagnostics = None
@@ -341,6 +344,7 @@ class Server:
             self._anti_entropy_timer.cancel()
         if self.cluster is not None:
             self.cluster.close()
+        self.api.scheduler.close()
         if self.http is not None:
             self.http.shutdown()
             self.http.server_close()

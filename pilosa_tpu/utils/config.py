@@ -76,6 +76,18 @@ class Config:
     # TTL the next boot (or bench run) reuses it instead of paying the
     # full device-init-timeout probe against a known-wedged transport
     device_probe_ttl: float = 900.0
+    # cross-query wave coalescing (docs/query-batching.md): concurrent
+    # sync device-routed queries share one dispatch+readback wave.
+    # "adaptive" opens a straggler window only under observed
+    # concurrency; "always" waits the full window per wave; "off"
+    # restores the one-wave-per-request path.
+    batch_mode: str = "adaptive"  # off | adaptive | always
+    # microseconds the wave leader holds the wave open for stragglers
+    # (the adaptive mode additionally caps this at half the readback-RTT
+    # EWMA, so a local device never waits longer than its RTT is worth)
+    batch_window_us: float = 250.0
+    # queries per wave before an immediate flush
+    batch_max_queries: int = 64
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -182,6 +194,9 @@ def config_template() -> str:
         "route-readback-ms = 2.0\n"
         "route-device-words-per-s = 25e9\n"
         "device-probe-ttl = 900.0\n"
+        'batch-mode = "adaptive"\n'
+        "batch-window-us = 250.0\n"
+        "batch-max-queries = 64\n"
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

@@ -29,6 +29,16 @@ def _log_buckets() -> tuple[float, ...]:
 DEFAULT_BUCKETS = _log_buckets()
 
 
+def _count_buckets() -> tuple[float, ...]:
+    """Power-of-two boundaries for COUNT distributions (wave occupancy,
+    batch sizes): 1..4096 — small counts resolve exactly, large ones to
+    within a factor of two."""
+    return tuple(float(1 << i) for i in range(13))
+
+
+COUNT_BUCKETS = _count_buckets()
+
+
 class Ewma:
     """Exponentially weighted moving average — the calibration primitive
     behind the query router's online crossover (executor/router.py): the
@@ -139,6 +149,9 @@ class StatsClient:
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
         self._timings: dict[tuple, Histogram] = {}
+        # non-latency value distributions (queries_per_wave): same
+        # Histogram machinery, count-shaped buckets, no _seconds suffix
+        self._dists: dict[tuple, Histogram] = {}
 
     @staticmethod
     def _key(name: str, tags: dict | None) -> tuple:
@@ -160,11 +173,28 @@ class StatsClient:
                 hist = self._timings[key] = Histogram()
         hist.observe(seconds)
 
+    def observe(self, name: str, value: float, tags: dict | None = None) -> None:
+        """Record into a VALUE distribution (e.g. ``queries_per_wave``):
+        a real histogram like timing(), but with count-shaped buckets
+        and no seconds unit."""
+        key = self._key(name, tags)
+        with self._lock:
+            hist = self._dists.get(key)
+            if hist is None:
+                hist = self._dists[key] = Histogram(COUNT_BUCKETS)
+        hist.observe(value)
+
     def histogram(self, name: str, tags: dict | None = None) -> Histogram | None:
         """The live Histogram behind a timer series (tests, bench, and
         the profile surface read percentiles through this)."""
         with self._lock:
             return self._timings.get(self._key(name, tags))
+
+    def distribution(self, name: str, tags: dict | None = None) -> Histogram | None:
+        """The live Histogram behind a value-distribution series
+        (bench reads queries_per_wave percentiles through this)."""
+        with self._lock:
+            return self._dists.get(self._key(name, tags))
 
     def close(self) -> None:
         """Release emission resources (no-op for registry-only clients)."""
@@ -194,11 +224,17 @@ class StatsClient:
             counters = dict(self._counters)
             gauges = dict(self._gauges)
             timings = dict(self._timings)
-        return {
+            dists = dict(self._dists)
+        out = {
             "counters": {fmt(k): v for k, v in counters.items()},
             "gauges": {fmt(k): v for k, v in gauges.items()},
             "timings": {fmt(k): h.snapshot() for k, h in timings.items()},
         }
+        if dists:
+            out["distributions"] = {
+                fmt(k): h.snapshot() for k, h in dists.items()
+            }
+        return out
 
     def _timing_family(self, name: str) -> str:
         """Timer series name → Prometheus metric family: the _seconds
@@ -216,6 +252,7 @@ class StatsClient:
             counters = sorted(self._counters.items())
             gauges = sorted(self._gauges.items())
             timings = sorted(self._timings.items())
+            dists = sorted(self._dists.items())
 
         def labels(k, extra: str = ""):
             inner = ",".join(f'{t}="{v}"' for t, v in k[1])
@@ -230,8 +267,11 @@ class StatsClient:
             lines.append(f"# TYPE {self.prefix}_{k[0]} gauge")
             lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
         seen_families = set()
-        for k, hist in timings:
-            family = self._timing_family(k[0])
+        # distributions expose under their bare name (no _seconds unit)
+        series = [(self._timing_family(k[0]), k, h) for k, h in timings] + [
+            (f"{self.prefix}_{k[0]}", k, h) for k, h in dists
+        ]
+        for family, k, hist in series:
             if family not in seen_families:
                 seen_families.add(family)
                 lines.append(f"# TYPE {family} histogram")
@@ -291,6 +331,12 @@ class StatsdStats(StatsClient):
         super().timing(name, seconds, tags)
         self._emit(name, self._num(seconds * 1e3), "ms", tags)
 
+    def observe(self, name: str, value: float, tags: dict | None = None) -> None:
+        # value distributions (queries_per_wave, legs_per_batch_rpc)
+        # emit as dogstatsd histograms — "every update" includes these
+        super().observe(name, value, tags)
+        self._emit(name, self._num(value), "h", tags)
+
     def close(self) -> None:
         self._sock.close()
 
@@ -330,4 +376,7 @@ class NopStats(StatsClient):
         pass
 
     def timing(self, *a, **k):
+        pass
+
+    def observe(self, *a, **k):
         pass

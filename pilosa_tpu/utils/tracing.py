@@ -163,6 +163,26 @@ class Tracer:
         finally:
             self._local.remote = prev
 
+    @contextmanager
+    def detached(self, trace_id: str | None, parent_span_id: str | None):
+        """Run the body OUTSIDE this thread's current span stack,
+        optionally joining a propagated context instead.  The wave
+        scheduler (executor/scheduler.py) executes queued queries on
+        the leader's thread: each query's spans must join the
+        SUBMITTER's trace (captured at enqueue), not nest under the
+        leader's own request span — otherwise every batched query's
+        trace would collapse into whichever request happened to lead
+        the wave."""
+        prev_cur = getattr(self._local, "current", None)
+        prev_rem = getattr(self._local, "remote", None)
+        self._local.current = None
+        self._local.remote = (trace_id, parent_span_id) if trace_id else None
+        try:
+            yield
+        finally:
+            self._local.current = prev_cur
+            self._local.remote = prev_rem
+
     def current_context(self) -> tuple[str, str] | None:
         """(trace_id, span_id) to INJECT into an outbound request — the
         active span's identity, or the activated remote context when no
@@ -261,13 +281,24 @@ class QueryProfile:
     log to name the slow shard group. Single-threaded by construction:
     the HTTP handler thread drives the whole query synchronously."""
 
-    __slots__ = ("trace_id", "total_seconds", "calls", "fanout", "_last_rpc_bytes")
+    __slots__ = (
+        "trace_id",
+        "total_seconds",
+        "calls",
+        "fanout",
+        "wave",
+        "_last_rpc_bytes",
+    )
 
     def __init__(self):
         self.trace_id: str | None = None
         self.total_seconds = 0.0
         self.calls: list[dict] = []  # local executor per-call entries
         self.fanout: list[dict] = []  # per-node shard-group entries
+        # set by the wave scheduler when this query rode a shared wave:
+        # {"queries": occupancy, "flushReason": ...} — the ?profile=true
+        # surface for cross-query coalescing
+        self.wave: dict | None = None
         self._last_rpc_bytes = 0
 
     def add_call(
@@ -333,6 +364,8 @@ class QueryProfile:
             "calls": self.calls,
             "fanout": self.fanout,
         }
+        if self.wave is not None:
+            out["wave"] = self.wave
         if self.trace_id:
             out["traceID"] = self.trace_id
         return out
@@ -355,3 +388,17 @@ def profile_query():
 
 def current_profile() -> QueryProfile | None:
     return getattr(_PROFILE, "current", None)
+
+
+@contextmanager
+def use_profile(prof: QueryProfile | None):
+    """Install a SPECIFIC profile (possibly None) as this thread's
+    collector — the wave scheduler dispatches queued queries on the
+    leader's thread, and each query's executor calls must land in the
+    profile its own submitter installed, not the leader's."""
+    prev = getattr(_PROFILE, "current", None)
+    _PROFILE.current = prof
+    try:
+        yield prof
+    finally:
+        _PROFILE.current = prev

@@ -39,6 +39,12 @@ def pytest_configure(config):
         "(tests/test_routing.py; runs in tier-1 — the marker exists so "
         "`pytest -m routing` scopes to it)",
     )
+    config.addinivalue_line(
+        "markers",
+        "batching: cross-query wave-coalescing suite "
+        "(tests/test_scheduler.py; runs in tier-1 — the marker exists so "
+        "`pytest -m batching` scopes to it)",
+    )
 
 
 @pytest.fixture

@@ -231,6 +231,91 @@ def test_config_drift_stale_doc_key_fails(tree_copy):
     assert "[config-drift]" in out and "bind-retired" in out
 
 
+def test_readback_leak_in_scheduler_fails(tree_copy):
+    # the scheduler is NOT blanket-sanctioned like the rest of
+    # executor/: a sync anywhere outside the named settlement function
+    # (fetch_wave) must flag — coordinating many requests' results is
+    # exactly where an accidental early sync would serialize every wave
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "scheduler.py",
+        "    def snapshot(self) -> dict:",
+        "    def snapshot(self) -> dict:\n"
+        "        probe = jnp.zeros(8)\n"
+        "        _leak = float(np.asarray(probe).sum())\n",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[readback]" in out and "scheduler.py" in out
+
+
+def test_readback_settlement_layer_stays_sanctioned(tree_copy):
+    # renaming fetch_wave strips its explicit sanction: the transfer
+    # inside it must then flag (proves the sanction is the NAME, not
+    # the file)
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "scheduler.py",
+        "def fetch_wave(",
+        "def fetch_wave_renamed(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[readback]" in out and "scheduler.py" in out
+
+
+def test_observability_missing_batch_handler_fails(tree_copy):
+    # the multi-query /internal route: client half spoken, server half
+    # gone — the rule must notice before a 404 does
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        "def _h_query_batch(",
+        "def _x_query_batch(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "_h_query_batch" in out
+
+
+def test_observability_unspanned_batch_handler_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        'with GLOBAL_TRACER.span("cluster.query_batch", queries=len(entries)):',
+        "if True:",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "_h_query_batch" in out
+
+
+def test_parity_scheduler_bypassing_dispatch_fails(tree_copy):
+    # the batch enqueue path must go through Executor.dispatch (the
+    # parity-covered entry); renaming the call simulates a rewrite that
+    # grows its own dispatch
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "scheduler.py",
+        "executor.dispatch(",
+        "executor.dispatch_private(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "dispatch" in out
+
+
+def test_parity_scheduler_call_name_switch_fails(tree_copy):
+    # a call.name-compare in the scheduler = a third dispatch table the
+    # executor/hostpath parity diff cannot see
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "scheduler.py",
+        '        if self.mode == "off":',
+        '        name = calls[0].name\n'
+        '        if name == "TopN":\n'
+        "            pass\n"
+        '        if self.mode == "off":',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "TopN" in out
+
+
 def test_readback_leak_in_server_fails(tree_copy):
     mutate(
         tree_copy / "pilosa_tpu" / "server" / "diagnostics.py",

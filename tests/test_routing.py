@@ -185,6 +185,34 @@ def test_estimate_words_scales_with_shape():
     assert estimate_words(idx, parse("Row(f=1)")[0], 4) == 4 * unit
 
 
+def test_1m_column_intersect_count_pins_host():
+    """ISSUE 4 satellite: the 1M-column sync PQL path — the
+    ``pql_intersect_count_1M_qps`` bench row that regressed to 0.04x in
+    BENCH_ALL_r05 by paying a full device dispatch+readback for ~65 µs
+    of host work — must be host-routed by the cost model under default
+    seeds, and must STAY host-routed as calibration folds in real
+    observations."""
+    h = Holder(None)
+    idx = h.create_index("m")
+    f = idx.create_field("f")
+    n_shards = -(-1_000_000 // SHARD_WIDTH)  # 1M columns at test width
+    for s in range(n_shards):
+        cols = np.arange(
+            s * SHARD_WIDTH, s * SHARD_WIDTH + 64, dtype=np.uint64
+        )
+        f.import_bulk(np.ones(64, dtype=np.uint64), cols)
+        f.import_bulk(np.full(64, 2, dtype=np.uint64), cols)
+        idx.mark_columns_exist(cols)
+    e = Executor(h)  # default router: auto mode, config-default seeds
+    pql = "Count(Intersect(Row(f=1), Row(f=2)))"
+    assert e.route_for("m", pql) == "host"
+    # executing feeds host calibration; the decision must not flip
+    for _ in range(3):
+        e.execute("m", pql)
+    assert e.route_for("m", pql) == "host"
+    assert e.router.decisions.get("device", 0) == 0
+
+
 # -------------------------------------------------- host/device parity
 @pytest.fixture(scope="module")
 def parity_rig():

@@ -1,0 +1,539 @@
+"""Cross-query wave coalescing (ISSUE 4): the dispatch scheduler that
+lets concurrent sync clients share device readback waves.
+
+Pillars:
+- batched-vs-solo equivalence over every PQL read call type (the wave
+  path must be a pure performance transform);
+- error isolation: one failing query in a wave errors alone;
+- window-timeout flush driven by a fake clock;
+- no-starvation fairness under sustained concurrency with tiny waves;
+- single-flight dedup correctness, including stack-token invalidation
+  under mutation (a query enqueued after a write never joins a
+  pre-write execution);
+- host-routed / write bypass, wave observability (stats distribution,
+  profile wave section, /debug/vars snapshot), and the multi-query
+  /internal RPC's per-entry isolation + trace propagation.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.field import FIELD_INT, FieldOptions
+from pilosa_tpu.executor import Executor, RowResult
+from pilosa_tpu.executor.scheduler import WaveScheduler, stack_token
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.stats import StatsClient
+
+pytestmark = pytest.mark.batching
+
+
+def make_rig(route_mode="device", **sched_kw):
+    rng = np.random.default_rng(11)
+    h = Holder(None)
+    idx = h.create_index("b")
+    f = idx.create_field("f")
+    g = idx.create_field("g")
+    v = idx.create_field(
+        "v", FieldOptions(field_type=FIELD_INT, min=-200, max=200)
+    )
+    n = 4000
+    cols = rng.integers(0, 2 * SHARD_WIDTH, n).astype(np.uint64)
+    f.import_bulk(rng.integers(0, 5, n).astype(np.uint64), cols)
+    g.import_bulk(rng.integers(0, 3, n).astype(np.uint64), cols)
+    vcols = np.unique(cols)
+    v.import_values(vcols, rng.integers(-200, 200, vcols.size).astype(np.int64))
+    idx.mark_columns_exist(cols)
+    stats = StatsClient()
+    e = Executor(h, stats=stats, route_mode=route_mode)
+    sched_kw.setdefault("stats", stats)
+    sched = WaveScheduler(lambda: e, **sched_kw)
+    return h, e, sched, stats
+
+
+READ_QUERIES = [
+    "Row(f=2)",
+    "Count(Union(Row(f=1), Row(f=2), Row(g=2)))",
+    "Count(Intersect(Row(f=1), Row(g=2)))",
+    "Count(Difference(Row(f=1), Row(g=0)))",
+    "Count(Xor(Row(f=1), Row(g=1)))",
+    "Count(Not(Row(f=1)))",
+    "Count(All())",
+    "Count(Shift(Row(f=1), n=3))",
+    "Sum(field=v)",
+    "Sum(Row(f=1), field=v)",
+    "Min(field=v)",
+    "Max(Row(g=2), field=v)",
+    "TopN(f, n=3)",
+    "TopN(f, ids=[0,2,4])",
+    "Count(Row(v > 50))",
+    "Count(Row(-50 < v < 50))",
+    "GroupBy(Rows(f), Rows(g))",
+    "GroupBy(Rows(f), Rows(g), limit=5)",
+    "GroupBy(Rows(f), aggregate=Sum(field=v))",
+    "Rows(f)",
+    "Options(Count(Row(f=1)), shards=[0,1])",
+    "Count(Row(f=1)) Count(Row(g=1)) TopN(f, n=2)",  # multi-call request
+]
+
+
+def _norm(results):
+    return json.dumps(
+        [r.to_json() if isinstance(r, RowResult) else r for r in results],
+        default=str,
+    )
+
+
+@pytest.mark.parametrize("pql", READ_QUERIES)
+def test_batched_vs_solo_equivalence(pql):
+    _h, e, sched, _stats = make_rig()
+    assert _norm(sched.execute("b", pql)) == _norm(e.execute("b", pql)), pql
+
+
+def test_concurrent_wave_equivalence_mixed_queries():
+    """Distinct queries fired concurrently share waves and still each
+    return exactly what a solo executor returns."""
+    _h, e, sched, stats = make_rig()
+    want = {pql: _norm(e.execute("b", pql)) for pql in READ_QUERIES}
+    got: dict = {}
+    errors: list = []
+    barrier = threading.Barrier(len(READ_QUERIES))
+
+    def run(pql):
+        barrier.wait()
+        try:
+            got[pql] = _norm(sched.execute("b", pql))
+        except Exception as exc:  # noqa: BLE001 — surfaced in the main thread
+            errors.append((pql, exc))
+
+    threads = [
+        threading.Thread(target=run, args=(p,), daemon=True)
+        for p in READ_QUERIES
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    assert got == want
+    snap = sched.snapshot()
+    # every query accounted for: waved, deduped, or direct (Rows(f) is
+    # metadata-only → host-routed → bypasses the window by design)
+    assert (
+        snap["batchedQueries"] + snap["dedupedQueries"] + snap["directQueries"]
+        >= len(READ_QUERIES)
+    )
+    # some coalescing must have happened across 22 concurrent queries
+    assert snap["waves"] < len(READ_QUERIES)
+    dist = stats.distribution("queries_per_wave")
+    assert dist is not None and dist.count == snap["waves"]
+
+
+def test_error_isolation_one_bad_query_in_wave():
+    _h, _e, sched, _stats = make_rig()
+    out = sched.execute_many(
+        [
+            ("b", "Count(Row(f=1))", None, None),
+            ("b", "Count(Row(nope=1))", None, None),  # unknown field
+            ("b", "TopN(f, n=2)", None, None),
+        ]
+    )
+    assert isinstance(out[0], list) and isinstance(out[0][0], int)
+    assert isinstance(out[1], Exception) and "nope" in str(out[1])
+    assert isinstance(out[2], list) and out[2][0]
+
+
+def test_error_isolation_concurrent_threads():
+    _h, e, sched, _stats = make_rig()
+    want = _norm(e.execute("b", "Count(Row(f=1))"))
+    results: dict = {}
+    barrier = threading.Barrier(3)
+
+    def good(k):
+        barrier.wait()
+        results[k] = _norm(sched.execute("b", "Count(Row(f=1))"))
+
+    def bad():
+        barrier.wait()
+        try:
+            sched.execute("b", "Count(Row(missing=1))")
+            results["bad"] = "no error"
+        except Exception as exc:  # noqa: BLE001 — the assertion target
+            results["bad"] = f"error:{exc}"
+
+    ts = [
+        threading.Thread(target=good, args=("g1",), daemon=True),
+        threading.Thread(target=good, args=("g2",), daemon=True),
+        threading.Thread(target=bad, daemon=True),
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(30)
+    assert results["g1"] == want and results["g2"] == want
+    assert results["bad"].startswith("error:") and "missing" in results["bad"]
+
+
+class FakeClock:
+    def __init__(self, step=0.001):
+        self.now = 0.0
+        self.step = step
+
+    def __call__(self):
+        self.now += self.step
+        return self.now
+
+
+def test_window_timeout_flush_fake_clock():
+    """mode=always holds every wave open for the full window; with a
+    fake clock driving the deadline and arrivals never landing, the
+    wave must flush with reason=timeout."""
+    _h, _e, sched, stats = make_rig(
+        mode="always", window_us=5000.0, clock=FakeClock()
+    )
+    waits: list[float] = []
+    sched._wait_arrival = waits.append  # no-op waiter, records timeouts
+    res = sched.execute("b", "Count(Row(f=1))")
+    assert isinstance(res[0], int)
+    assert waits and all(w > 0 for w in waits)
+    counters = stats.expvar()["counters"]
+    assert counters.get("wave_flush_reason{reason=timeout}") == 1
+
+
+def test_adaptive_solo_traffic_skips_window():
+    """At occupancy ~1 the adaptive window must be zero — the c1 sync
+    latency guard: flush reason is solo, and the injected waiter is
+    never consulted."""
+    _h, _e, sched, stats = make_rig(mode="adaptive")
+    waits: list[float] = []
+    sched._wait_arrival = waits.append
+    for _ in range(3):
+        sched.execute("b", "Count(Row(f=1))")
+    assert waits == []
+    counters = stats.expvar()["counters"]
+    assert counters.get("wave_flush_reason{reason=solo}") == 3
+
+
+def test_no_starvation_tiny_waves():
+    """max_queries=2 forces many waves; every query must complete and
+    return its own correct result (FIFO drain: nothing starves)."""
+    _h, e, sched, _stats = make_rig(max_queries=2)
+    queries = [f"Count(Row(f={i % 5}))" for i in range(24)]
+    want = [_norm(e.execute("b", q)) for q in queries]
+    got: list = [None] * len(queries)
+    barrier = threading.Barrier(len(queries))
+
+    def run(i):
+        barrier.wait()
+        got[i] = _norm(sched.execute("b", queries[i]))
+
+    ts = [
+        threading.Thread(target=run, args=(i,), daemon=True)
+        for i in range(len(queries))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert got == want
+    assert sched.snapshot()["waves"] >= 2
+
+
+def test_single_flight_dedup_shares_one_execution():
+    _h, e, sched, stats = make_rig()
+    calls = []
+    orig = e.dispatch
+    e.dispatch = lambda *a, **k: calls.append(1) or orig(*a, **k)
+    out = sched.execute_many(
+        [("b", "TopN(f, n=3)", None, None)] * 4
+    )
+    assert len(calls) == 1
+    assert all(o == out[0] for o in out)
+    assert sched.snapshot()["dedupedQueries"] == 3
+    counters = stats.expvar()["counters"]
+    assert counters.get("queries_deduped") == 3
+
+
+def test_dedup_stack_token_moves_on_mutation():
+    h, e, sched, _stats = make_rig()
+    idx = h.index("b")
+    before = stack_token(idx)
+    e.execute("b", "Set(1, f=1)")
+    assert stack_token(idx) > before
+
+
+def test_dedup_not_joined_across_mutation():
+    """A query submitted AFTER a write must not join an identical
+    pre-write in-flight execution: the stack token in the dedup key
+    forces a fresh execution that sees the write."""
+    h, e, sched, _stats = make_rig()
+    idx = h.index("b")
+    pql = "Count(Row(f=1))"
+    base = e.execute("b", pql)[0]
+    gate = threading.Event()
+    entered = threading.Event()
+    calls = []
+    orig = e.dispatch
+
+    def blocking_dispatch(*a, **k):
+        calls.append(1)
+        if len(calls) == 1:
+            entered.set()
+            assert gate.wait(30)
+        return orig(*a, **k)
+
+    e.dispatch = blocking_dispatch
+    res: dict = {}
+    t1 = threading.Thread(
+        target=lambda: res.__setitem__("a", sched.execute("b", pql)[0]),
+        daemon=True,
+    )
+    t1.start()
+    assert entered.wait(30)  # prime is mid-dispatch, not sealed
+    # land a write that adds a NEW column to f=1 (bumps the view version)
+    free_col = int(2 * SHARD_WIDTH - 1)
+    f = idx.field("f")
+    f.set_bit(1, free_col)
+    idx.mark_columns_exist(np.array([free_col], dtype=np.uint64))
+    t2 = threading.Thread(
+        target=lambda: res.__setitem__("b", sched.execute("b", pql)[0]),
+        daemon=True,
+    )
+    t2.start()
+    time.sleep(0.05)  # let t2 enqueue (token differs → no join)
+    gate.set()
+    t1.join(30)
+    t2.join(30)
+    assert len(calls) == 2, "post-write query must not share the execution"
+    assert res["b"] == base + 1
+    assert res["a"] in (base, base + 1)  # racing write: either order legal
+
+
+def test_host_routed_and_writes_bypass_waves():
+    _h, _e, sched, _stats = make_rig(route_mode="host")
+    assert sched.execute("b", "Count(Row(f=1))")[0] >= 0
+    snap = sched.snapshot()
+    assert snap["waves"] == 0 and snap["directQueries"] == 1
+    # writes bypass even on a device-routed executor
+    _h2, _e2, sched2, _stats2 = make_rig()
+    assert sched2.execute("b", "Set(9, f=1)") == [True]
+    assert sched2.snapshot()["waves"] == 0
+
+
+def test_batch_mode_off_is_direct():
+    _h, e, sched, _stats = make_rig(mode="off")
+    assert _norm(sched.execute("b", "TopN(f, n=2)")) == _norm(
+        e.execute("b", "TopN(f, n=2)")
+    )
+    snap = sched.snapshot()
+    assert snap["waves"] == 0 and snap["directQueries"] == 1
+
+
+def test_profile_carries_wave_section():
+    _h, _e, sched, _stats = make_rig()
+    with tracing.profile_query() as prof:
+        sched.execute("b", "Count(Row(f=1))")
+    j = prof.to_json()
+    assert j["wave"]["queries"] == 1
+    assert j["wave"]["flushReason"] in ("solo", "drain", "timeout", "full")
+    assert any(c["call"] == "_readback" for c in j["calls"])
+    assert any(c["call"] == "Count" for c in j["calls"])
+
+
+def test_dedup_follower_profile_gets_wave_section():
+    """A ?profile=true query answered by single-flight dedup still
+    documents the shared wave: the follower's own profile carries the
+    wave dict + the shared _readback line (docs/observability.md)."""
+    _h, e, sched, _stats = make_rig()
+    pql = "Count(Row(f=1))"
+    gate = threading.Event()
+    entered = threading.Event()
+    calls = []
+    orig = e.dispatch
+
+    def blocking_dispatch(*a, **k):
+        calls.append(1)
+        if len(calls) == 1:
+            entered.set()
+            assert gate.wait(30)
+        return orig(*a, **k)
+
+    e.dispatch = blocking_dispatch
+    profs: dict = {}
+
+    def run(k, release=False):
+        with tracing.profile_query() as prof:
+            sched.execute("b", pql)
+        profs[k] = prof.to_json()
+
+    t1 = threading.Thread(target=run, args=("prime",), daemon=True)
+    t1.start()
+    assert entered.wait(30)  # prime mid-dispatch → follower will join
+    t2 = threading.Thread(target=run, args=("follower",), daemon=True)
+    t2.start()
+    time.sleep(0.05)
+    gate.set()
+    t1.join(30)
+    t2.join(30)
+    assert len(calls) == 1, "identical query must have shared the execution"
+    for k in ("prime", "follower"):
+        assert profs[k]["wave"]["shared"] >= 2, (k, profs[k])
+        assert any(c["call"] == "_readback" for c in profs[k]["calls"]), k
+
+
+def test_wave_occupancy_feeds_router():
+    _h, e, sched, _stats = make_rig()
+    out = sched.execute_many([("b", "Count(Row(f=1))", None, None)] * 6)
+    assert all(isinstance(o, list) for o in out)
+    assert e.router.wave_occupancy.value > 1.0
+    assert e.router.snapshot()["waveOccupancy"] > 1.0
+    # amortized device overhead: higher occupancy → cheaper device cost
+    solo_cost = (
+        e.router.dispatch_s.value + e.router.readback_s.value
+    ) + 0.0
+    assert e.router.device_cost(0) < solo_cost
+
+
+def test_invalid_batch_mode_rejected():
+    with pytest.raises(ValueError):
+        WaveScheduler(lambda: None, mode="sometimes")
+
+
+def test_debug_vars_exposes_query_batching(tmp_path):
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.utils.config import Config
+    from tests.test_cluster import free_ports
+
+    port = free_ports(1)[0]
+    srv = Server(
+        Config(bind=f"127.0.0.1:{port}", data_dir=str(tmp_path / "d"))
+    )
+    srv.open()
+    try:
+        srv.wait_mesh(60)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/vars"
+        ) as r:
+            out = json.loads(r.read())
+        assert out["queryBatching"]["mode"] == "adaptive"
+        assert "meanQueriesPerWave" in out["queryBatching"]
+    finally:
+        srv.close()
+
+
+def test_internal_query_batch_route(tmp_path):
+    """The multi-query /internal RPC: per-entry results, per-entry
+    error isolation, per-entry trace propagation."""
+    from pilosa_tpu.parallel.client import InternalClient, PeerError
+    from tests.test_cluster import call, free_ports, make_cluster, shutdown
+
+    servers, ports, _seeds = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/qb", {})
+        call(ports[0], "POST", "/index/qb/field/f", {})
+        cols = list(range(0, 3 * SHARD_WIDTH, 97))
+        call(
+            ports[0],
+            "POST",
+            "/index/qb/field/f/import",
+            {"rowIDs": [1] * len(cols), "columnIDs": cols},
+        )
+        client = InternalClient()
+        # the batch RPC executes the TARGET node's local shards (same
+        # contract as the single /internal/query RPC): expectation comes
+        # from that RPC, not the cluster-wide client route
+        expect = client.query_node(
+            f"http://127.0.0.1:{ports[1]}", "qb", "Count(Row(f=1))", None
+        )[0]
+        trace_id = "ab" * 16
+        outs = client.query_batch_node(
+            f"http://127.0.0.1:{ports[1]}",
+            [
+                {
+                    "index": "qb",
+                    "query": "Count(Row(f=1))",
+                    "shards": None,
+                    "traceId": trace_id,
+                    "parentSpanId": "cd" * 8,
+                },
+                {
+                    "index": "qb",
+                    "query": "Count(Row(ghost=1))",
+                    "shards": None,
+                    "traceId": None,
+                    "parentSpanId": None,
+                },
+            ],
+        )
+        assert outs[0][0] == expect
+        assert isinstance(outs[1], PeerError) and "ghost" in str(outs[1])
+        # the entry's spans joined ITS propagated trace on the peer
+        # (scheduler.query when the entry rode a wave, executor.* when
+        # the cost router sent it direct/host — either way the trace id
+        # from the RPC BODY must parent the remote work)
+        spans = call(
+            ports[1], "GET", f"/debug/traces?trace_id={trace_id}"
+        )["spans"]
+        assert spans and all(s["traceID"] == trace_id for s in spans)
+        assert any(
+            s["name"].startswith(("scheduler.", "executor.")) for s in spans
+        )
+    finally:
+        shutdown(servers)
+
+
+def test_cluster_concurrent_queries_coalesce_legs(tmp_path):
+    """Concurrent client queries against a 2-node cluster stay correct
+    with leg coalescing active (the batcher's group-commit path)."""
+    from tests.test_cluster import call, free_ports, make_cluster, shutdown
+
+    servers, ports, _seeds = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/cc", {})
+        call(ports[0], "POST", "/index/cc/field/f", {})
+        cols = list(range(0, 6 * SHARD_WIDTH, 61))
+        for lo in range(0, len(cols), 4000):
+            call(
+                ports[0],
+                "POST",
+                "/index/cc/field/f/import",
+                {
+                    "rowIDs": [1] * len(cols[lo : lo + 4000]),
+                    "columnIDs": cols[lo : lo + 4000],
+                },
+            )
+        expect = call(ports[0], "POST", "/index/cc/query",
+                      b"Count(Row(f=1))")["results"][0]
+        errors: list = []
+        got: list = [None] * 12
+        barrier = threading.Barrier(12)
+
+        def run(i):
+            barrier.wait()
+            try:
+                got[i] = call(
+                    ports[i % 2], "POST", "/index/cc/query",
+                    b"Count(Row(f=1))",
+                )["results"][0]
+            except Exception as exc:  # noqa: BLE001 — surfaced below
+                errors.append(exc)
+
+        ts = [
+            threading.Thread(target=run, args=(i,), daemon=True)
+            for i in range(12)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errors, errors
+        assert got == [expect] * 12
+    finally:
+        shutdown(servers)

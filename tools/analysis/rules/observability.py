@@ -11,10 +11,17 @@ always be attributable.  Enforced structurally:
    contains a ``GLOBAL_TRACER.span`` call, a ``stats.count`` call and a
    ``stats.timer``/``stats.timing`` call, so no handler can opt out;
 3. **fan-out** — in ``parallel/cluster.py``, any function that calls
-   ``client.query_node`` (the query scatter RPC) must itself open a
+   ``client.query_node`` OR ``client.query_batch_node`` (the single-
+   and multi-query scatter RPCs) must itself open a
    ``GLOBAL_TRACER.span`` and record a ``stats.timing``/``timer`` —
    per-leg latency is the input to the slow-shard naming in the
-   long-query log, so an untimed fan-out silently breaks it.
+   long-query log, so an untimed fan-out silently breaks it;
+4. **multi-query route** — when the cluster layer speaks the coalesced
+   ``/internal/query/batch`` RPC (any ``query_batch_node`` reference),
+   its server half ``_h_query_batch`` must exist and must span
+   (``GLOBAL_TRACER.span``/``activate``), histogram-time
+   (``timer``/``timing``) and count (``queries_served``) the batch —
+   wave coalescing must never make remote legs untraceable.
 
 Files are located by project-relative suffix so tests can run the rule
 against a mutated copy of the tree.
@@ -135,25 +142,70 @@ def check_observability(project: Project) -> list[Violation]:
 
     cluster = project.find(CLUSTER)
     if cluster is not None and cluster.tree is not None:
+        batch_rpc_used = False
+        batch_handler: ast.FunctionDef | None = None
         for node in ast.walk(cluster.tree):
             if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            if not _has_call(node, "client.query_node"):
-                continue
-            missing = []
-            if not _has_call(node, "GLOBAL_TRACER.span", ".span"):
-                missing.append("tracing span")
-            if not _has_call(node, ".timing", ".timer"):
-                missing.append("latency histogram")
-            if missing:
+            if node.name == "_h_query_batch":
+                batch_handler = node
+            for rpc in ("client.query_node", "client.query_batch_node"):
+                if not _has_call(node, rpc):
+                    continue
+                if rpc.endswith("query_batch_node"):
+                    batch_rpc_used = True
+                missing = []
+                if not _has_call(node, "GLOBAL_TRACER.span", ".span"):
+                    missing.append("tracing span")
+                if not _has_call(node, ".timing", ".timer"):
+                    missing.append("latency histogram")
+                if missing:
+                    out.append(
+                        Violation(
+                            "observability",
+                            cluster.rel,
+                            node.lineno,
+                            f"fan-out {node.name}() calls {rpc} "
+                            f"without a {' or '.join(missing)} — per-leg "
+                            "latency becomes unattributable",
+                        )
+                    )
+        if batch_rpc_used:
+            # the multi-query /internal/query/batch route: the client
+            # half exists, so the server half must serve it traced,
+            # histogram-timed, and counted — coalescing must not turn
+            # remote legs into dark traffic
+            if batch_handler is None:
                 out.append(
                     Violation(
                         "observability",
                         cluster.rel,
-                        node.lineno,
-                        f"fan-out {node.name}() calls client.query_node "
-                        f"without a {' or '.join(missing)} — per-leg "
-                        "latency becomes unattributable",
+                        1,
+                        "client.query_batch_node is spoken but no "
+                        "_h_query_batch handler serves the multi-query "
+                        "/internal route",
                     )
                 )
+            else:
+                missing = []
+                if not _has_call(batch_handler, ".span", ".activate"):
+                    missing.append("tracing span")
+                if not _has_call(batch_handler, ".timing", ".timer"):
+                    missing.append("latency histogram")
+                if not any(
+                    isinstance(n, ast.Constant) and n.value == "queries_served"
+                    for n in ast.walk(batch_handler)
+                ):
+                    missing.append("queries_served counter")
+                if missing:
+                    out.append(
+                        Violation(
+                            "observability",
+                            cluster.rel,
+                            batch_handler.lineno,
+                            "_h_query_batch (multi-query /internal route) "
+                            f"missing {' and '.join(missing)} — batched "
+                            "remote legs would serve dark",
+                        )
+                    )
     return out

@@ -14,9 +14,16 @@ model happens to route host-side that day.  Three static diffs:
 3. every read call type dispatched in ``Executor._execute_call`` must
    reach a ``compiler.host`` reference — directly in its branch or via
    one ``self._execute_*`` hop (writes, ``Options`` and the
-   metadata-only ``Rows`` are exempt).
+   metadata-only ``Rows`` are exempt);
+4. the batch enqueue path: the cross-query wave scheduler
+   (``executor/scheduler.py``) must funnel wave execution through
+   ``Executor.dispatch`` and its direct path through
+   ``Executor.execute`` — the two entries the diffs above cover — and
+   must not grow a per-call-type dispatch switch of its own (a
+   ``call.name``-compare there would be a third dispatch table the
+   host/device diffs cannot see).
 
-The rule locates the two files by project-relative suffix, so tests can
+The rule locates the files by project-relative suffix, so tests can
 run it against a mutated copy of the tree.
 """
 
@@ -34,6 +41,7 @@ from tools.analysis.engine import (
 
 EXECUTOR = "executor/executor.py"
 HOSTPATH = "executor/hostpath.py"
+SCHEDULER = "executor/scheduler.py"
 _EXEMPT = {"Options", "Rows"}
 
 
@@ -205,4 +213,56 @@ def check_parity(project: Project) -> list[Violation]:
                     "host-engine coverage for this call type",
                 )
             )
+
+    # 4. the batch enqueue path stays on the parity-covered entries
+    sched = project.find(SCHEDULER)
+    if sched is not None and sched.tree is not None:
+        sched_cls = _class(sched.tree, "WaveScheduler")
+        if sched_cls is not None:
+            calls_in_cls = {
+                call_name(n.func)
+                for n in ast.walk(sched_cls)
+                if isinstance(n, ast.Call)
+            }
+            if not any(c.endswith(".dispatch") for c in calls_in_cls):
+                out.append(
+                    Violation(
+                        "parity",
+                        sched.rel,
+                        sched_cls.lineno,
+                        "WaveScheduler never calls Executor.dispatch — "
+                        "batched queries bypass the parity-covered "
+                        "dispatch entry, so host/device call-type drift "
+                        "would go unseen on the batch path",
+                    )
+                )
+            if not any(c.endswith(".execute") for c in calls_in_cls):
+                out.append(
+                    Violation(
+                        "parity",
+                        sched.rel,
+                        sched_cls.lineno,
+                        "WaveScheduler never calls Executor.execute — "
+                        "the direct (non-batchable) path must reuse the "
+                        "parity-covered entry, not its own dispatch",
+                    )
+                )
+            # no third dispatch table: comparing call .name literals in
+            # the scheduler would fork call-type handling away from the
+            # executor/hostpath diff above (WRITE_CALLS membership tests
+            # via unwrap_options are fine — they compare sets, not names)
+            for m in _methods(sched_cls).values():
+                compared = _compared_names(m, "name")
+                if compared:
+                    out.append(
+                        Violation(
+                            "parity",
+                            sched.rel,
+                            m.lineno,
+                            f"scheduler method {m.name}() compares call "
+                            f"names {sorted(compared)} — a third per-call "
+                            "dispatch table the executor/hostpath parity "
+                            "diff cannot cover",
+                        )
+                    )
     return out
