@@ -846,6 +846,186 @@ def config8_concurrency_sweep():
         sys.exit(1)
 
 
+def config9_degraded_cluster():
+    """ISSUE 5: degraded-cluster read serving — 3-node in-process
+    cluster (replica_n=2) with the peer the coordinator's routing
+    actually picks blackholed via seeded fault injection (simulated
+    data-plane timeout: delay + drop, while /status heartbeats keep
+    reporting it alive — the nastiest shape: a peer that looks healthy
+    and hangs queries).  Measures aggregate read QPS and p95 through
+    the surviving coordinator with the circuit breaker ON vs OFF
+    against the healthy baseline.  Exits non-zero when breaker-on p95
+    regresses past the healthy baseline by more than the configured
+    guard (PILOSA_BENCH_DEGRADED_P95_GUARD, default 3.0x): the breaker
+    must cap a dead peer's cost at one fast-fail per query, never a
+    per-query data-plane timeout."""
+    import sys
+    import tempfile
+    import threading as _threading
+    import urllib.request
+
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils.config import Config
+
+    guard = float(os.environ.get("PILOSA_BENCH_DEGRADED_P95_GUARD", "3.0"))
+    blackhole_delay_ms = 150.0
+    n_clients, per_client = 8, 15
+    q = b"Count(Intersect(Row(f=1), Row(f=2)))"
+
+    def call(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/c/query", data=body, method="POST"
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+    tmp = tempfile.mkdtemp()
+    # enough shards that the coordinator is a non-holder for SOME shard
+    # with near-certainty ((2/3)^24 ≈ 6e-5 — placement hashes ephemeral
+    # port-derived node ids, so this varies run to run)
+    n_shards = 24
+    rng = np.random.default_rng(11)
+    cols = rng.integers(0, n_shards * SHARD_WIDTH, 30_000).tolist()
+    rows = rng.integers(0, 4, 30_000).tolist()
+
+    def build(tag, breaker_on):
+        ports = free_ports(3)
+        seeds = [f"http://127.0.0.1:{p}" for p in ports]
+        servers = []
+        for i, p in enumerate(ports):
+            cfg = Config(
+                bind=f"127.0.0.1:{p}",
+                data_dir=f"{tmp}/{tag}{i}",
+                seeds=seeds,
+                replica_n=2,
+                anti_entropy_interval=0,
+                coordinator=(i == 0),
+                # long heartbeat: the degraded window must not be
+                # healed mid-measurement by a liveness tick
+                heartbeat_interval=60.0,
+                rpc_retries=0,
+                breaker_enabled=breaker_on,
+                breaker_failure_threshold=1,
+                breaker_cooldown_ms=60_000.0,
+            )
+            s = Server(cfg)
+            s.open()
+            servers.append(s)
+        for s in servers:
+            s.wait_mesh(60)
+            s.cluster._heartbeat_once()
+        post(ports[0], "/index/c", {})
+        post(ports[0], "/index/c/field/f", {})
+        for lo in range(0, len(cols), 4000):
+            post(ports[0], "/index/c/field/f/import",
+                 {"rowIDs": rows[lo:lo + 4000],
+                  "columnIDs": cols[lo:lo + 4000]})
+        return servers, ports
+
+    def sweep(port):
+        """Concurrent clients against ONE node (the survivor's view is
+        what degrades); returns (qps, p95_ms) over the client-observed
+        latency histogram."""
+        from pilosa_tpu.utils.stats import Histogram
+
+        hist = Histogram()
+        errors: list = []
+        barrier = _threading.Barrier(n_clients + 1)
+
+        def client():
+            barrier.wait()
+            try:
+                for _ in range(per_client):
+                    t0 = time.perf_counter()
+                    call(port, q)
+                    hist.observe(time.perf_counter() - t0)
+            except Exception as e:  # noqa: BLE001 — surfaced below
+                errors.append(e)
+
+        threads = [
+            _threading.Thread(target=client, daemon=True)
+            for _ in range(n_clients)
+        ]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in threads:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return n_clients * per_client / dt, hist.percentile(0.95) * 1e3
+
+    def degrade(server):
+        """Blackhole the peer the coordinator's routing actually picks
+        (a hardcoded victim is flaky — placement hashes the ephemeral
+        port-derived node ids), then re-mark it alive so queries keep
+        routing into the fault until failover/breaker handles it."""
+        cl = server.cluster
+        holdings = cl._read_holdings("c")
+        victim = next(
+            n for s in range(n_shards)
+            if (n := cl._pick_read_node("c", s, holdings)) is not None
+            and n.id != cl.me.id
+        )
+        server.fault_injector.set_rules(
+            [{"peer": victim.id, "path": "/internal/",
+              "action": "blackhole", "delay_ms": blackhole_delay_ms}],
+            seed=23,
+        )
+        for n in cl.nodes:
+            n.alive = True
+
+    def run(tag, breaker_on):
+        servers, ports = build(tag, breaker_on)
+        try:
+            call(ports[0], q)  # warm the program cache
+            healthy_qps, healthy_p95 = sweep(ports[0])
+            degrade(servers[0])
+            qps, p95 = sweep(ports[0])
+            for n in servers[0].cluster.nodes:
+                n.alive = True
+        finally:
+            for s in servers:
+                s.close()
+        return healthy_qps, healthy_p95, qps, p95
+
+    try:
+        # each run is normalized against ITS OWN cluster's healthy
+        # sweep — placement varies with the ephemeral ports, so mixing
+        # baselines across the two builds would skew the ratio
+        healthy_qps_on, healthy_p95_on, qps_on, p95_on = run("on", True)
+        _hq_off, _hp_off, qps_off, p95_off = run("off", False)
+    finally:
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    extra = {
+        "healthy_p95_ms": round(healthy_p95_on, 3),
+        "degraded_p95_ms_breaker_on": round(p95_on, 3),
+        "degraded_p95_ms_breaker_off": round(p95_off, 3),
+        "degraded_qps_breaker_off": round(qps_off, 3),
+        "blackhole_delay_ms": blackhole_delay_ms,
+        "p95_guard": guard,
+    }
+    line("degraded_read_qps_3node_1dead", qps_on, "qps",
+         qps_on / healthy_qps_on if healthy_qps_on else 0.0, extra=extra)
+    if healthy_p95_on > 0 and p95_on > guard * healthy_p95_on:
+        line("degraded_p95_guard_FAILED", p95_on / healthy_p95_on, "ratio",
+             0.0, extra=extra)
+        sys.exit(1)
+
+
 def transport_context(emit: bool = True):
     """The sync dispatch+readback RTT floor. On a tunneled (remote)
     accelerator every SYNC query pays this regardless of device work, so
@@ -879,6 +1059,7 @@ CONFIGS = {
     "6": config6_ingest,
     "7": config7_cluster_read,
     "8": config8_concurrency_sweep,
+    "9": config9_degraded_cluster,
 }
 
 

@@ -211,6 +211,17 @@ class WaveScheduler:
         """Drop-in for Executor.execute: same signature, same results,
         same exceptions — batchable device-routed queries ride a shared
         wave, everything else runs direct."""
+        # per-query deadline (docs/fault-tolerance.md): a query whose
+        # budget is already spent must fail with the labeled 504 error
+        # BEFORE enqueueing — joining a wave it can no longer wait for
+        # would burn device work on an answer nobody is listening to.
+        # Deferred import: parallel.resilience is a leaf over client.py,
+        # but executor modules must not pull parallel/ in at import time.
+        from pilosa_tpu.parallel.resilience import current_deadline
+
+        deadline = current_deadline()
+        if deadline is not None and deadline.expired():
+            raise deadline.exceeded("scheduler enqueue")
         executor = self._executor_fn()
         calls = parse(query) if isinstance(query, str) else query
         batchable, routes = self._batchable(executor, index, calls, shards)
@@ -477,8 +488,20 @@ class WaveScheduler:
         self._cond.wait(timeout)
 
     def _window_seconds(self, executor, have: int) -> float:
+        from pilosa_tpu.parallel.resilience import current_deadline
+
+        # the straggler window is bounded by the leader's own query
+        # deadline: a wave must never hold its leader past the budget
+        # the client was promised (retries upstream already consumed
+        # their share — see docs/fault-tolerance.md)
+        deadline = current_deadline()
+        budget = deadline.remaining() if deadline is not None else None
+        if budget is not None and budget <= 0:
+            return 0.0
         if self.mode == "always":
-            return self.window_s
+            return (
+                self.window_s if budget is None else min(self.window_s, budget)
+            )
         # adaptive: solo traffic never pays the window (the c1 latency
         # guard); once waves coalesce — occupancy EWMA above the solo
         # threshold, or multiple queries already drained — wait for
@@ -491,7 +514,8 @@ class WaveScheduler:
         occ_v = occ.value if occ is not None and occ.value else 1.0
         if occ_v <= _SOLO_OCCUPANCY and have <= 1:
             return 0.0
-        return min(self.window_s, 0.5 * router.readback_s.value)
+        eff = min(self.window_s, 0.5 * router.readback_s.value)
+        return eff if budget is None else min(eff, budget)
 
     def _execute_wave(
         self, executor, batch: list[_WorkItem], reason: str

@@ -18,9 +18,32 @@ from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 
 class PeerError(RuntimeError):
-    def __init__(self, uri: str, detail: str):
+    """A node→node RPC failed.  ``status`` carries the HTTP status code
+    when the peer answered with one (None for transport-level failures
+    — refused/reset/timeout), so callers classify structurally instead
+    of string-matching the message."""
+
+    def __init__(self, uri: str, detail: str, status: int | None = None):
         super().__init__(f"peer {uri}: {detail}")
         self.uri = uri
+        self.status = status
+
+    @property
+    def retryable(self) -> bool:
+        """Safe to retry/fail over: transport failures and server-side
+        5xx are transient by classification; a 4xx is a permanent
+        request error that every replica would refuse identically."""
+        return self.status is None or self.status >= 500
+
+
+class BreakerOpenError(PeerError):
+    """Fast-fail from an OPEN circuit breaker: no round trip was made.
+    Retryable by classification — the CLUSTER layer fails the leg over
+    to a replica (the per-peer retry loop never re-attempts an open
+    peer; the breaker gate runs before every attempt)."""
+
+    def __init__(self, uri: str, detail: str):
+        super().__init__(uri, detail, status=None)
 
 
 class InternalClient:
@@ -53,9 +76,23 @@ class InternalClient:
         timeout: float | None = None,
         content_type: str = "application/json",
     ) -> bytes:
+        # deferred import: resilience imports this module at load time
+        from pilosa_tpu.parallel import resilience
+
         req = urllib.request.Request(uri + path, data=body, method=method)
         if body is not None:
             req.add_header("Content-Type", content_type)
+        # per-query deadline budget: cap the socket timeout at the
+        # remaining budget and forward it (decremented by construction —
+        # the header always carries what is LEFT at send time) so the
+        # receiving hop bounds its own work to the same promise
+        deadline = resilience.current_deadline()
+        if deadline is not None:
+            rem = deadline.remaining()
+            if rem <= 0:
+                raise deadline.exceeded(f"RPC to {uri}{path}")
+            timeout = min(self.timeout if timeout is None else timeout, rem)
+            req.add_header(resilience.DEADLINE_HEADER, str(int(rem * 1e3)))
         # trace propagation (Inject): the receiving node's spans join the
         # caller's trace and parent onto the span active on this thread
         ctx = GLOBAL_TRACER.current_context()
@@ -76,7 +113,7 @@ class InternalClient:
                 return data
         except urllib.error.HTTPError as e:
             detail = e.read().decode(errors="replace")
-            raise PeerError(uri, f"HTTP {e.code}: {detail}") from e
+            raise PeerError(uri, f"HTTP {e.code}: {detail}", status=e.code) from e
         except OSError as e:
             raise PeerError(uri, str(e)) from e
 

@@ -34,9 +34,11 @@ from pilosa_tpu.parallel.resultwire import (  # noqa: F401 (re-exported)
     decode_result,
     encode_result,
 )
-from pilosa_tpu.parallel.client import (
-    InternalClient,
-    PeerError,
+from pilosa_tpu.parallel import resilience
+from pilosa_tpu.parallel.client import PeerError
+from pilosa_tpu.parallel.resilience import (
+    DeadlineExceededError,
+    make_resilient_client,
 )
 from pilosa_tpu.parallel.topology import (
     STATE_DEGRADED,
@@ -66,17 +68,19 @@ class _Leg:
         "pql",
         "shards",
         "ctx",
+        "deadline",
         "done",
         "results",
         "error",
         "bytes",
     )
 
-    def __init__(self, index: str, pql: str, shards, ctx):
+    def __init__(self, index: str, pql: str, shards, ctx, deadline=None):
         self.index = index
         self.pql = pql
         self.shards = shards
         self.ctx = ctx  # (trace_id, span_id) of the submitting thread
+        self.deadline = deadline  # the SUBMITTER's query deadline
         self.done = threading.Event()
         self.results: list | None = None
         self.error: BaseException | None = None
@@ -118,7 +122,13 @@ class _NodeLegBatcher:
         self._busy: set[str] = set()
 
     def call(self, node: "Node", index: str, pql: str, shards) -> list:
-        leg = _Leg(index, pql, shards, GLOBAL_TRACER.current_context())
+        leg = _Leg(
+            index,
+            pql,
+            shards,
+            GLOBAL_TRACER.current_context(),
+            deadline=resilience.current_deadline(),
+        )
         if getattr(self.cluster.config, "batch_mode", "adaptive") == "off":
             # no coalescing: one solo-leg send, still spanned + timed
             self._send(node, [leg])
@@ -184,6 +194,24 @@ class _NodeLegBatcher:
             with self._cond:
                 self._cond.notify_all()
 
+    @staticmethod
+    def _envelope_context(legs: list[_Leg]):
+        """The deadline the (possibly shared) RPC runs under.  The
+        sender thread's OWN thread-local deadline must never apply — it
+        may be draining other threads' legs, and one nearly-expired
+        query would fail or throttle its envelope-mates.  A solo leg
+        gets its submitter's deadline; a shared envelope is bounded by
+        the LONGEST remaining budget among its legs (so no leg is
+        starved by a shorter co-rider — a cut at that bound means every
+        leg's budget is spent), or unbounded when any leg is."""
+        deadlines = [leg.deadline for leg in legs]
+        if any(d is None for d in deadlines):
+            return resilience.use_query_context(None)
+        widest = max(deadlines, key=lambda d: d.remaining())
+        return resilience.use_query_context(
+            resilience.QueryContext(deadline=widest)
+        )
+
     def _send(self, node: "Node", legs: list[_Leg]) -> None:
         client = self.cluster.client
         stats = self.cluster.server.stats
@@ -205,10 +233,11 @@ class _NodeLegBatcher:
                     # context (the sender may be draining another
                     # thread's leg)
                     with GLOBAL_TRACER.detached(ctx[0], ctx[1]):
-                        with tracing.use_profile(scratch):
-                            leg.results = client.query_node(
-                                node.uri, leg.index, leg.pql, leg.shards
-                            )
+                        with self._envelope_context([leg]):
+                            with tracing.use_profile(scratch):
+                                leg.results = client.query_node(
+                                    node.uri, leg.index, leg.pql, leg.shards
+                                )
                     leg.bytes = scratch.take_rpc_bytes()
                     leg.done.set()
                 else:
@@ -222,8 +251,9 @@ class _NodeLegBatcher:
                         }
                         for leg in legs
                     ]
-                    with tracing.use_profile(scratch):
-                        outs = client.query_batch_node(node.uri, entries)
+                    with self._envelope_context(legs):
+                        with tracing.use_profile(scratch):
+                            outs = client.query_batch_node(node.uri, entries)
                     share = scratch.take_rpc_bytes() // len(legs)
                     for leg, out in zip(legs, outs):
                         leg.bytes = share
@@ -236,9 +266,15 @@ class _NodeLegBatcher:
                 # failure (transport, malformed peer reply, version
                 # skew) fails this RPC's legs and keeps the drain loop
                 # pumping; letting it propagate would strand the legs
-                # still queued behind it
-                err = e if isinstance(e, PeerError) else PeerError(
-                    node.uri, f"batched query RPC failed: {e!r}"
+                # still queued behind it. A deadline cut keeps its own
+                # type so the submitter surfaces the labeled 504, not a
+                # transport error that would trigger pointless failover.
+                err = (
+                    e
+                    if isinstance(e, (PeerError, DeadlineExceededError))
+                    else PeerError(
+                        node.uri, f"batched query RPC failed: {e!r}"
+                    )
                 )
                 for leg in legs:
                     if not leg.done.is_set():
@@ -267,7 +303,16 @@ class Cluster:
     def __init__(self, server):
         self.server = server
         self.config = server.config
-        self.client = InternalClient(skip_verify=self.config.tls_skip_verify)
+        # the resilient RPC chain (docs/fault-tolerance.md): transport →
+        # fault injection (armed via config or /debug/faults) → retry +
+        # per-peer circuit breakers. Every data-plane call site below
+        # goes through this wrapper — the `resilience` analyzer rule
+        # forbids naked InternalClient use here.
+        self.client = make_resilient_client(
+            self.config,
+            stats=server.stats,
+            injector=getattr(server, "fault_injector", None),
+        )
         # per-peer fan-out leg coalescer: concurrent legs to one node
         # share a multi-query /internal/query/batch RPC (batch-mode=off
         # restores the one-RPC-per-leg path)
@@ -316,6 +361,16 @@ class Cluster:
         self._rebalance_thread: threading.Thread | None = None
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
         self._import_exec_lock = threading.Lock()
+        # bounded pool for the concurrent heartbeat /status sweep.
+        # Created EAGERLY (threads only spawn on first submit, so this
+        # is free) — lazy creation raced close(): a shutdown landing
+        # between the None-check and the construction would leak the
+        # probe threads past server close.
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._hb_exec = ThreadPoolExecutor(
+            max_workers=8, thread_name_prefix="hb-probe"
+        )
         self._closed = False
         # translate-primary failover fencing (reference: translate.go has a
         # FIXED primary; this cluster fails allocation over to the
@@ -531,6 +586,7 @@ class Cluster:
             self._hb_timer.cancel()
         if self._import_exec is not None:
             self._import_exec.shutdown(wait=False)
+        self._hb_exec.shutdown(wait=False)
 
     def _import_pool(self):
         if self._import_exec is None:
@@ -550,6 +606,29 @@ class Cluster:
             if n.id != self.me.id and (n.alive or not alive_only)
         ]
 
+    def _probe_peers(self, peers: list[Node]) -> list[dict | None]:
+        """Concurrent /status sweep (bounded thread fan-out): one hung
+        peer used to delay dead-marking every peer behind it by up to
+        its full 5s probe timeout — serially, a heartbeat over P peers
+        with one wedged could stretch to P×5s. Probes overlap; results
+        come back aligned with ``peers`` (None = unreachable). All
+        topology/inventory mutation stays on the heartbeat thread."""
+
+        def probe(node: Node) -> dict | None:
+            try:
+                return self.client.status(node.uri, timeout=5.0)
+            except PeerError:
+                return None
+
+        if len(peers) <= 1:
+            return [probe(n) for n in peers]
+        try:
+            return list(self._hb_exec.map(probe, peers))
+        except RuntimeError:
+            # close() shut the pool down while this tick was in flight:
+            # report everything unreachable; no further ticks schedule
+            return [None] * len(peers)
+
     def _heartbeat_once(self) -> None:
         degraded = False
         # Topology reconciliation is EPOCH-based: every applied add/remove
@@ -561,16 +640,17 @@ class Cluster:
         # Match on URI, not id: ids are config-dependent (a node's own id
         # may be its `name` while peers know it by host:port).
         best: tuple[int, list[dict]] | None = None
-        for n in self._peers(alive_only=False):
-            with self._shard_cache_lock:  # consistent vs in-flight stamps
-                c0 = self._inv_clock  # BEFORE the fetch
-            try:
-                st = self.client.status(n.uri, timeout=5.0)
-                n.alive = True
-            except PeerError:
+        with self._shard_cache_lock:  # consistent vs in-flight stamps
+            c0 = self._inv_clock  # BEFORE any fetch: an announce racing
+            # the concurrent sweep stamps > c0, so its (node, index)
+            # snapshot entries are skipped rather than wiped
+        peers = self._peers(alive_only=False)
+        for n, st in zip(peers, self._probe_peers(peers)):
+            if st is None:
                 n.alive = False
                 degraded = True
                 continue
+            n.alive = True
             self._apply_status_inventory(n, st, c0)
             ep = st.get("topologyEpoch")
             peer_nodes = [d for d in st.get("nodes", []) if d.get("uri")]
@@ -1098,7 +1178,16 @@ class Cluster:
                 results.append(self._route_write(index, inner))
             else:
                 results.append(self._route_read(index, call, shards))
-        return self.server.api.build_response(results)
+        resp = self.server.api.build_response(results)
+        qctx = resilience.current_query_context()
+        if qctx is not None and qctx.partial_shards:
+            # ?allow-partial=true and at least one shard had no
+            # surviving replica: label the degradation on the response
+            # (and in metrics) — a silently partial answer is the one
+            # thing this path must never produce
+            resp["partialShards"] = sorted(set(qctx.partial_shards))
+            self.server.stats.count("queries_partial")
+        return resp
 
     def _route_read(self, index: str, call: Call, shards: list[int] | None) -> Any:
         # scatter only the inner call of an Options() wrapper: result
@@ -1130,65 +1219,39 @@ class Cluster:
             all_shards = [0]
         by_node: dict[str, list[int]] = {}
         node_by_id = {n.id: n for n in self.nodes}
-        # per-node holdings resolved ONCE per read, not per shard (the
-        # local available_shards set is a union over all fragments)
-        idx_obj = self.server.holder.index(index)
-        local_avail = idx_obj.available_shards() if idx_obj else set()
-        holdings = {
-            n.id: (
-                local_avail
-                if n.id == self.me.id
-                else self._peer_shards.get((n.id, index), ())
-            )
-            for n in self.nodes
-        }
-        read_alive = [n for n in self.nodes if self._alive_for_read(n)]
+        holdings = self._read_holdings(index)
+        qctx = resilience.current_query_context()
         for s in all_shards:
-            alive_owners = [
-                n for n in self.shard_nodes(index, s) if self._alive_for_read(n)
-            ]
-            if not alive_owners:
+            primary = self._pick_read_node(index, s, holdings)
+            if primary is None:
+                # ?allow-partial=true opts into serving what survives:
+                # the skipped shard is recorded and surfaces on the
+                # response as the partialShards annotation — silence is
+                # never an option, degradation must be labeled
+                if qctx is not None and qctx.allow_partial:
+                    qctx.partial_shards.append(s)
+                    continue
                 raise ShardUnavailableError(f"no alive owner for shard {s}")
-            # PREFER an owner that actually HOLDS the fragment:
-            # mid-resize a shard's new owner may still be pulling, and
-            # routing there would silently count zeros. The previous
-            # holder keeps its copy until the anti-entropy handoff
-            # completes, so falling back to ANY alive node reporting the
-            # shard serves exact data through the window (reference:
-            # ResizeJob serves from the old assignment until the job
-            # completes). Last resort — nobody reports the shard at
-            # all — is alive_owners[0], which may still be pulling.
-            holders = [n for n in alive_owners if s in holdings[n.id]]
-            if holders:
-                # Replica read load-balancing (reference: cluster.go
-                # shardNodes — any replica serves a read). Serve locally
-                # when this node is a holder (a local partial costs no
-                # RPC at all — what makes full replication scale reads
-                # linearly with nodes); otherwise pick a holder by a
-                # PER-SHARD-stable hash: different shards land on
-                # different replicas (aggregate load spreads), while one
-                # shard's reads stay pinned to one replica — alternating
-                # replicas per request would make a replica that missed a
-                # write (owner down at write time, repaired by the next
-                # anti-entropy pass) visible as answers FLAPPING between
-                # values on identical back-to-back queries.
-                local = next(
-                    (n for n in holders if n.id == self.me.id), None
-                )
-                primary = (
-                    local
-                    if local is not None
-                    else holders[(s ^ (s >> 7)) % len(holders)]
-                )
-            else:
-                primary = next(
-                    (n for n in read_alive if s in holdings[n.id]),
-                    alive_owners[0],
-                )
             by_node.setdefault(primary.id, []).append(s)
+        if not by_node:
+            # every shard skipped (partial mode with no survivors):
+            # nothing to scatter — reduce over an empty partial set
+            return reduce_results(call, [])
 
         send = call
-        if call.name == "GroupBy" and len(by_node) > 1:
+        # A scatter with ANY remote leg can SPLIT mid-query: in-query
+        # failover re-plans a failed leg's shards across surviving
+        # replicas, so len(by_node) == 1 only proves a single-node
+        # merge when that node is THIS one (local legs cannot fail
+        # over). The exact multi-node merge transforms (GroupBy limit
+        # pinning, TopN two-phase/n-strip) must therefore be chosen
+        # whenever a remote leg exists — otherwise a failover during
+        # the degraded window would merge limit-truncated per-node
+        # partials and under-count.
+        multi = len(by_node) > 1 or any(
+            nid != self.me.id for nid in by_node
+        )
+        if call.name == "GroupBy" and multi:
             # Per-node truncation before a cross-node merge under-counts:
             # a group cut by `limit` on node A but not node B merges with
             # only B's partial count. Strip the GroupBy limit (re-applied
@@ -1205,7 +1268,7 @@ class Cluster:
             call.name == "TopN"
             and call.arg("n") is not None
             and call.arg("ids") is None
-            and len(by_node) > 1
+            and multi
         ):
             partials = self._topn_two_phase(index, call, by_node, node_by_id)
         else:
@@ -1213,7 +1276,7 @@ class Cluster:
                 call.name == "TopN"
                 and call.arg("ids") is not None
                 and call.arg("n") is not None
-                and len(by_node) > 1
+                and multi
             ):
                 # ids= recounts are exact per node, but a local n cut
                 # would truncate them back to partial lists — strip n for
@@ -1244,6 +1307,77 @@ class Cluster:
                     apply_options(idx, wrapper, result)
         return result
 
+    def _read_holdings(self, index: str) -> dict[str, Any]:
+        """Per-node shard holdings resolved ONCE per read (the local
+        available_shards set is a union over all fragments; peers come
+        from the announced-inventory cache — zero RPCs)."""
+        idx_obj = self.server.holder.index(index)
+        local_avail = idx_obj.available_shards() if idx_obj else set()
+        return {
+            n.id: (
+                local_avail
+                if n.id == self.me.id
+                else self._peer_shards.get((n.id, index), ())
+            )
+            for n in self.nodes
+        }
+
+    def _pick_read_node(
+        self,
+        index: str,
+        s: int,
+        holdings: dict[str, Any],
+        exclude: frozenset[str] | set[str] = frozenset(),
+    ) -> Node | None:
+        """The node that should execute shard ``s`` for a read, or None
+        when no candidate survives (``exclude`` names peers that already
+        failed this query — in-query failover re-plans around them).
+
+        PREFER an owner that actually HOLDS the fragment: mid-resize a
+        shard's new owner may still be pulling, and routing there would
+        silently count zeros. The previous holder keeps its copy until
+        the anti-entropy handoff completes, so falling back to ANY alive
+        node reporting the shard serves exact data through the window
+        (reference: ResizeJob serves from the old assignment until the
+        job completes). Last resort — nobody reports the shard at all —
+        is the first alive owner, which may still be pulling."""
+        alive_owners = [
+            n
+            for n in self.shard_nodes(index, s)
+            if self._alive_for_read(n) and n.id not in exclude
+        ]
+        if not alive_owners:
+            return None
+        holders = [n for n in alive_owners if s in holdings[n.id]]
+        if holders:
+            # Replica read load-balancing (reference: cluster.go
+            # shardNodes — any replica serves a read). Serve locally
+            # when this node is a holder (a local partial costs no
+            # RPC at all — what makes full replication scale reads
+            # linearly with nodes); otherwise pick a holder by a
+            # PER-SHARD-stable hash: different shards land on
+            # different replicas (aggregate load spreads), while one
+            # shard's reads stay pinned to one replica — alternating
+            # replicas per request would make a replica that missed a
+            # write (owner down at write time, repaired by the next
+            # anti-entropy pass) visible as answers FLAPPING between
+            # values on identical back-to-back queries.
+            local = next((n for n in holders if n.id == self.me.id), None)
+            return (
+                local
+                if local is not None
+                else holders[(s ^ (s >> 7)) % len(holders)]
+            )
+        read_alive = [
+            n
+            for n in self.nodes
+            if self._alive_for_read(n) and n.id not in exclude
+        ]
+        return next(
+            (n for n in read_alive if s in holdings[n.id]),
+            alive_owners[0],
+        )
+
     def _timed_query_node(
         self,
         span_name: str,
@@ -1251,6 +1385,7 @@ class Cluster:
         index: str,
         pql: str,
         shards: list[int] | None,
+        write: bool = False,
     ) -> tuple[list[Any], float]:
         """One fan-out RPC leg with the observability contract applied
         in ONE place: a tracing span + the ``fanout_rpc_seconds``
@@ -1260,12 +1395,24 @@ class Cluster:
         covers queue wait + the (possibly shared) round trip — per-leg
         latency as the CALLER experienced it.  Returns (decoded results,
         elapsed seconds); a failed leg raises before the histogram
-        records, same as before extraction."""
+        records, same as before extraction.
+
+        ``write=True`` legs (the replica write fan-out) take the
+        single-shot RPC instead: OUTSIDE the leg coalescer (a write must
+        not ride an envelope whose transport retry would replay it) and
+        OUTSIDE the retry scope (``query_node_once``) — a replayed
+        Set/Clear is a duplicated write, so writes fail loudly and leave
+        the retry decision to the client."""
         t0 = time.perf_counter()
         with GLOBAL_TRACER.span(
             span_name, node=node.id, shards=len(shards) if shards else 0
         ):
-            result = self._legs.call(node, index, pql, shards)
+            if write:
+                result = self.client.query_node_once(
+                    node.uri, index, pql, shards
+                )
+            else:
+                result = self._legs.call(node, index, pql, shards)
         elapsed = time.perf_counter() - t0
         if self.server.stats is not None:
             self.server.stats.timing(
@@ -1283,11 +1430,29 @@ class Cluster:
         """Scatter one call to its shard owners, gather decoded partials.
         Every leg records fan-out latency (histogram + span + profile
         shard-group entry) so tail latency is attributable to the node —
-        and therefore the shards — that caused it."""
+        and therefore the shards — that caused it.
+
+        In-query replica FAILOVER (docs/fault-tolerance.md): a leg that
+        fails with a retryable error — transport drop, 5xx, breaker
+        fast-fail — after the client wrapper's own same-peer retries no
+        longer errors the query.  The peer is marked dead (so concurrent
+        queries stop routing to it), the leg's shards re-plan onto the
+        next surviving replica owner, and the scatter continues.  Each
+        failure permanently excludes that peer for THIS query, so the
+        loop is bounded by the node count.  A shard with no surviving
+        owner fails the query — unless the client opted into
+        ?allow-partial=true, in which case it joins the response's
+        partialShards annotation.  Permanent errors (4xx: the peer
+        answered and refused) are not failed over — every replica would
+        refuse identically."""
         partials: list[Any] = []
         prof = tracing.current_profile()
         stats = self.server.stats
-        for node_id, node_shards in by_node.items():
+        pending: list[tuple[str, list[int]]] = list(by_node.items())
+        failed: set[str] = set()
+        holdings: dict[str, Any] | None = None
+        while pending:
+            node_id, node_shards = pending.pop()
             t0 = time.perf_counter()
             if node_id == self.me.id:
                 # this node serves its own shard group — counts toward
@@ -1313,27 +1478,60 @@ class Cluster:
                         0,
                     )
                 continue
+            node = node_by_id[node_id]
             try:
                 remote, elapsed = self._timed_query_node(
                     "cluster.fanout",
-                    node_by_id[node_id],
+                    node,
                     index,
                     call.to_pql(),
                     node_shards,
                 )
             except PeerError as e:
+                probing = "device probe in progress" in str(e)
+                if not e.retryable and not probing:
+                    # the peer ANSWERED with a permanent refusal (4xx):
+                    # no replica would answer differently — fail loudly,
+                    # and don't dead-mark a peer that is demonstrably up
+                    raise ShardUnavailableError(
+                        f"shard owner {node_id} failed mid-query: {e}"
+                    ) from e
                 # a probe-gate 503 means the peer is ALIVE and serving
                 # (its heartbeats succeed) but its device verdict is
                 # pending — marking it dead would route reads around a
-                # live sole holder on every client retry for the whole
-                # probe window. Any other failure: heartbeat state was
-                # stale — mark dead NOW so the next read reroutes to a
-                # replica, and fail this one loudly either way.
-                if "device probe in progress" not in str(e):
-                    node_by_id[node_id].alive = False
-                raise ShardUnavailableError(
-                    f"shard owner {node_id} failed mid-query: {e}"
-                ) from e
+                # live sole holder for the whole probe window; still
+                # fail THIS query's legs over to a surviving replica.
+                # Any other retryable failure: heartbeat state was
+                # stale — mark dead NOW so concurrent queries reroute.
+                if not probing:
+                    node.alive = False
+                failed.add(node_id)
+                if stats is not None:
+                    stats.count("legs_failed_over")
+                if holdings is None:
+                    holdings = self._read_holdings(index)
+                lost: list[int] = []
+                replan: dict[str, list[int]] = {}
+                for s in node_shards:
+                    target = self._pick_read_node(
+                        index, s, holdings, exclude=failed
+                    )
+                    if target is None:
+                        lost.append(s)
+                    else:
+                        replan.setdefault(target.id, []).append(s)
+                        node_by_id.setdefault(target.id, target)
+                if lost:
+                    qctx = resilience.current_query_context()
+                    if qctx is not None and qctx.allow_partial:
+                        qctx.partial_shards.extend(lost)
+                    else:
+                        raise ShardUnavailableError(
+                            f"shard owner {node_id} failed mid-query and "
+                            f"no replica survives for shards {lost}: {e}"
+                        ) from e
+                pending.extend(replan.items())
+                continue
             if prof is not None:
                 prof.add_fanout(
                     call.name,
@@ -1657,6 +1855,7 @@ class Cluster:
                         index,
                         call.to_pql(),
                         [shard],
+                        write=True,
                     )
                     r = remote[0]
                 took_write.append(owner.uri)
@@ -1684,7 +1883,8 @@ class Cluster:
                 r = self.server.api.executor.execute(index, [call])[0]
             else:
                 remote, _ = self._timed_query_node(
-                    "cluster.write_fanout", n, index, call.to_pql(), None
+                    "cluster.write_fanout", n, index, call.to_pql(), None,
+                    write=True,
                 )
                 r = remote[0]
             if isinstance(r, bool):
@@ -2472,6 +2672,25 @@ class Cluster:
         }
         http.extra_routes.update(routes)
 
+    @staticmethod
+    def _hop_query_context(handler):
+        """Context manager installing the fan-out hop's share of the
+        caller's deadline budget: ``X-Pilosa-Deadline-Ms`` carries the
+        REMAINING milliseconds at send time, so this hop's retries and
+        wave waits are bounded by what the original client was promised
+        (decrement-per-hop by construction — each hop re-forwards only
+        what is left on its own clock)."""
+        import contextlib
+
+        deadline = resilience.deadline_from_header(
+            handler.headers.get(resilience.DEADLINE_HEADER)
+        )
+        if deadline is None:
+            return contextlib.nullcontext()
+        return resilience.use_query_context(
+            resilience.QueryContext(deadline=deadline)
+        )
+
     # each handler receives the live request Handler object
     def _h_query(self, handler) -> None:
         # body FIRST, gate second: the 503 must not leave unread body
@@ -2496,9 +2715,10 @@ class Cluster:
         # through the wave scheduler: concurrent remote legs from
         # different coordinators (or wave-mates) share this node's
         # device dispatch/readback waves exactly like client queries
-        results = self.server.api.scheduler.execute(
-            body["index"], body["query"], shards=body.get("shards")
-        )
+        with self._hop_query_context(handler):
+            results = self.server.api.scheduler.execute(
+                body["index"], body["query"], shards=body.get("shards")
+            )
         # framed response: JSON control + raw packed-word blobs — a wide
         # Row() partial crosses the wire at 4 bytes/word instead of
         # base64's 5.33 plus JSON string parse (reference: internal
@@ -2537,7 +2757,8 @@ class Cluster:
             )
         with GLOBAL_TRACER.span("cluster.query_batch", queries=len(entries)):
             with stats.timer("internal_query_batch_seconds"):
-                results = self.server.api.scheduler.execute_many(reqs)
+                with self._hop_query_context(handler):
+                    results = self.server.api.scheduler.execute_many(reqs)
         blobs: list[bytes] = []
         out: list[dict] = []
         for r in results:

@@ -1,0 +1,209 @@
+"""Deterministic fault injection for the node→node data plane.
+
+Every behavior in docs/fault-tolerance.md — retry-then-succeed, replica
+failover, breaker trips, deadline exhaustion — is testable without real
+network chaos: a ``FaultInjector`` holds seeded, rule-based faults and
+``FaultInjectingClient`` (an ``InternalClient`` subclass) consults them
+at the single transport chokepoint (``_request``) before any bytes move.
+Faults therefore surface to callers exactly like real failures do (as
+``PeerError`` with a status, or as added latency), underneath the retry
+and breaker layers in ``parallel/resilience.py``.
+
+Rules are JSON objects:
+
+    {"peer": "127.0.0.1:9101",      # substring match on the peer URI ("" = all)
+     "path": "/internal/query",     # prefix match on the request path ("" = all)
+     "method": "POST",              # exact match ("" = all)
+     "action": "http",              # drop | delay | http | blackhole
+     "status": 503,                 # http action: injected status code
+     "times": 2,                    # fire for the first N matches, then inert
+                                    # (0/absent = every match; the
+                                    #  "first-N-then-ok" shape)
+     "delay_ms": 100, "jitter_ms": 50}   # delay action; also honored as a
+                                         # pre-fault latency on drop/blackhole
+
+Actions: ``drop`` raises a connection-reset-shaped transport error;
+``delay`` sleeps ``delay_ms`` + U(0, jitter_ms) (seeded RNG) then lets
+the request proceed; ``http`` fails with the given 5xx/4xx status;
+``blackhole`` fails EVERY match until the rule set is cleared (the
+unreachable-peer shape — ``times`` is ignored).
+
+Configured three ways: the ``fault-rules`` config key (JSON list) /
+``PILOSA_TPU_FAULT_RULES`` env var, seeded by ``fault-seed``; or at
+runtime through the debug route (GET/POST/DELETE ``/debug/faults``) so
+an operator can rehearse a failure on a live cluster and clear it
+without a restart.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import time
+
+from pilosa_tpu.parallel.client import InternalClient, PeerError
+
+_ACTIONS = ("drop", "delay", "http", "blackhole")
+
+
+class FaultRule:
+    __slots__ = (
+        "peer", "path", "method", "action", "status", "times",
+        "delay_ms", "jitter_ms", "fires",
+    )
+
+    def __init__(self, spec: dict):
+        self.peer = spec.get("peer", "")
+        self.path = spec.get("path", "")
+        self.method = spec.get("method", "")
+        self.action = spec.get("action", "drop")
+        if self.action not in _ACTIONS:
+            raise ValueError(
+                f"fault action must be one of {_ACTIONS}, got {self.action!r}"
+            )
+        self.status = int(spec.get("status", 503))
+        self.times = int(spec.get("times", 0))
+        self.delay_ms = float(spec.get("delay_ms", 0.0))
+        self.jitter_ms = float(spec.get("jitter_ms", 0.0))
+        self.fires = 0
+
+    def matches(self, method: str, uri: str, path: str) -> bool:
+        if self.method and self.method != method:
+            return False
+        if self.peer and self.peer not in uri:
+            return False
+        if self.path and not path.startswith(self.path):
+            return False
+        # blackhole ignores `times`: it fails until cleared
+        if self.action != "blackhole" and self.times > 0 and self.fires >= self.times:
+            return False
+        return True
+
+    def to_json(self) -> dict:
+        return {
+            "peer": self.peer,
+            "path": self.path,
+            "method": self.method,
+            "action": self.action,
+            "status": self.status,
+            "times": self.times,
+            "delay_ms": self.delay_ms,
+            "jitter_ms": self.jitter_ms,
+            "fires": self.fires,
+        }
+
+
+class FaultInjector:
+    """Seeded rule set shared by one node's outgoing client chain and
+    its /debug/faults route.  Thread-safe; the no-rules fast path is one
+    attribute read, so an always-installed injector costs nothing in
+    production."""
+
+    def __init__(self, rules: list[dict] | None = None, seed: int = 0,
+                 sleep=time.sleep):
+        self._lock = threading.Lock()
+        self._rules: list[FaultRule] = []
+        self._rng = random.Random(seed)
+        self.seed = seed
+        self._sleep = sleep
+        # concurrency high-water mark across delayed requests — the
+        # heartbeat-fan-out test reads this to prove probes overlap
+        self._active = 0
+        self.max_concurrent = 0
+        if rules:
+            self.set_rules(rules, seed)
+
+    @classmethod
+    def from_config(cls, config) -> "FaultInjector":
+        rules: list[dict] = []
+        raw = getattr(config, "fault_rules", "") or ""
+        if raw:
+            parsed = json.loads(raw)
+            if not isinstance(parsed, list):
+                raise ValueError("fault-rules must be a JSON list of rules")
+            rules = parsed
+        return cls(rules, seed=getattr(config, "fault_seed", 0))
+
+    @property
+    def armed(self) -> bool:
+        return bool(self._rules)
+
+    def set_rules(self, rules: list[dict], seed: int | None = None) -> None:
+        parsed = [FaultRule(r) for r in rules]
+        with self._lock:
+            if seed is not None:
+                self.seed = seed
+                self._rng = random.Random(seed)
+            self._rules = parsed
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules = []
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "seed": self.seed,
+                "rules": [r.to_json() for r in self._rules],
+                "maxConcurrent": self.max_concurrent,
+            }
+
+    def before_request(self, method: str, uri: str, path: str) -> None:
+        """Apply the first matching rule (rule order is the tiebreak —
+        deterministic given the same arrival order).  Raises PeerError
+        for failure actions; returns after sleeping for delay actions."""
+        if not self._rules:
+            return
+        with self._lock:
+            rule = next(
+                (r for r in self._rules if r.matches(method, uri, path)), None
+            )
+            if rule is None:
+                return
+            rule.fires += 1
+            delay_s = 0.0
+            if rule.delay_ms > 0 or rule.jitter_ms > 0:
+                delay_s = (
+                    rule.delay_ms + self._rng.uniform(0.0, rule.jitter_ms)
+                ) / 1e3
+            action, status = rule.action, rule.status
+            if delay_s > 0:
+                self._active += 1
+                self.max_concurrent = max(self.max_concurrent, self._active)
+        try:
+            if delay_s > 0:
+                self._sleep(delay_s)
+        finally:
+            if delay_s > 0:
+                with self._lock:
+                    self._active -= 1
+        if action == "delay":
+            return
+        if action == "http":
+            raise PeerError(
+                uri, f"HTTP {status}: injected fault", status=status
+            )
+        if action == "blackhole":
+            raise PeerError(uri, "injected blackhole: peer unreachable")
+        raise PeerError(uri, "injected connection drop: connection reset")
+
+
+class FaultInjectingClient(InternalClient):
+    """InternalClient with the injector consulted at the transport
+    chokepoint.  ``injector=None`` behaves exactly like the base class
+    (and is what standalone/non-cluster servers get)."""
+
+    def __init__(self, timeout: float = 30.0, skip_verify: bool = False,
+                 injector: FaultInjector | None = None):
+        super().__init__(timeout=timeout, skip_verify=skip_verify)
+        self.injector = injector
+
+    def _request(self, method, uri, path, body=None, timeout=None,
+                 content_type="application/json"):
+        inj = self.injector
+        if inj is not None:
+            inj.before_request(method, uri, path)
+        return super()._request(
+            method, uri, path, body, timeout=timeout, content_type=content_type
+        )

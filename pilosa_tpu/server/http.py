@@ -31,6 +31,8 @@ from urllib.parse import parse_qs, urlparse
 
 from pilosa_tpu import __version__, encoding
 from pilosa_tpu.executor import ExecutionError
+from pilosa_tpu.parallel import resilience
+from pilosa_tpu.parallel.resilience import DeadlineExceededError
 from pilosa_tpu.parallel.topology import ShardUnavailableError
 from pilosa_tpu.server.api import RequestTooLargeError
 from pilosa_tpu.pql import PQLError
@@ -60,6 +62,9 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/debug/faults$"), "debug_faults"),
+    ("POST", re.compile(r"^/debug/faults$"), "debug_faults_set"),
+    ("DELETE", re.compile(r"^/debug/faults$"), "debug_faults_clear"),
     ("GET", re.compile(r"^/debug/pprof/profile$"), "pprof_profile"),
     ("GET", re.compile(r"^/debug/pprof/goroutine$"), "pprof_goroutine"),
     ("GET", re.compile(r"^/debug/pprof/heap$"), "pprof_heap"),
@@ -148,6 +153,11 @@ class Handler(BaseHTTPRequestHandler):
             self._error(str(e), code=413)
         except (ExecutionError, PQLError, ValueError, KeyError) as e:
             self._error(str(e), code=400)
+        except DeadlineExceededError as e:
+            # the labeled per-query timeout (docs/fault-tolerance.md):
+            # 504, never a generic 500/503 — a budget cut is the
+            # client's contract working, not a server fault
+            self._error(str(e), code=504)
         except ShardUnavailableError as e:
             self._error(str(e), code=503)
         except (BrokenPipeError, ConnectionResetError):
@@ -289,6 +299,25 @@ class Handler(BaseHTTPRequestHandler):
             self._json({"error": msg}, code=503, extra_headers=headers)
         return False
 
+    def _query_context(self) -> "resilience.QueryContext":
+        """Per-query resilience context (docs/fault-tolerance.md): the
+        deadline budget — an explicit ``X-Pilosa-Deadline-Ms`` header
+        (the remaining budget of an upstream hop, or a client opting
+        into a tighter bound) wins over the server's configured
+        ``query-timeout-ms`` default — plus the ``?allow-partial=true``
+        opt-in for labeled partial results under replica loss."""
+        deadline = resilience.deadline_from_header(
+            self.headers.get(resilience.DEADLINE_HEADER)
+        )
+        if deadline is None and self.server.query_timeout_ms > 0:
+            deadline = resilience.Deadline(self.server.query_timeout_ms / 1e3)
+        allow_partial = self.query_params.get("allow-partial", [""])[
+            0
+        ].lower() in ("true", "1")
+        return resilience.QueryContext(
+            deadline=deadline, allow_partial=allow_partial
+        )
+
     def h_query(self, index: str) -> None:
         import time
 
@@ -306,15 +335,17 @@ class Handler(BaseHTTPRequestHandler):
             "true",
             "1",
         )
+        qctx = self._query_context()
         t0 = time.perf_counter()
         # the profile collector is always installed (a handful of dict
         # appends per query) so the long-query log can name the slow
         # shard group even when the client didn't ask for a profile
-        with tracing.profile_query() as prof:
-            with self.stats.timer("query_seconds", tags={"index": index}):
-                with GLOBAL_TRACER.span("pql.query", index=index) as sp:
-                    prof.trace_id = sp.trace_id
-                    resp = self.server.query_router(index, pql, shards)
+        with resilience.use_query_context(qctx):
+            with tracing.profile_query() as prof:
+                with self.stats.timer("query_seconds", tags={"index": index}):
+                    with GLOBAL_TRACER.span("pql.query", index=index) as sp:
+                        prof.trace_id = sp.trace_id
+                        resp = self.server.query_router(index, pql, shards)
         elapsed = time.perf_counter() - t0
         prof.total_seconds = elapsed
         slow = self.server.long_query_time
@@ -504,6 +535,33 @@ class Handler(BaseHTTPRequestHandler):
         else:
             self._json({"spans": GLOBAL_TRACER.recent()})
 
+    # fault-injection debug surface (docs/fault-tolerance.md): inspect,
+    # arm, and clear this node's OUTGOING data-plane fault rules at
+    # runtime — chaos rehearsal on a live cluster without a restart
+    def _fault_injector(self):
+        inj = self.server.fault_injector
+        if inj is None:
+            raise ValueError(
+                "fault injection is not wired on this server (runtime "
+                "Server instances install an injector at open())"
+            )
+        return inj
+
+    def h_debug_faults(self) -> None:
+        self._json(self._fault_injector().snapshot())
+
+    def h_debug_faults_set(self) -> None:
+        body = self._json_body()
+        rules = body.get("rules", [])
+        if not isinstance(rules, list):
+            raise ValueError("'rules' must be a JSON list of fault rules")
+        self._fault_injector().set_rules(rules, seed=body.get("seed"))
+        self._json({"success": True, "rules": len(rules)})
+
+    def h_debug_faults_clear(self) -> None:
+        self._fault_injector().clear()
+        self._json({"success": True})
+
     # /debug/pprof analogue (reference: net/http/pprof in http/handler.go)
     def h_pprof_profile(self) -> None:
         from pilosa_tpu.utils import profiling
@@ -618,6 +676,12 @@ class HTTPServer(ThreadingHTTPServer):
         self.stats = stats or StatsClient()
         self.node_id = "local"
         self.long_query_time = 0.0
+        # per-query deadline default (config query-timeout-ms; 0 = off)
+        self.query_timeout_ms = 0.0
+        # the runtime Server installs its FaultInjector here so the
+        # /debug/faults routes drive the same rule set the node's
+        # outgoing data-plane client consults
+        self.fault_injector = None
         # device-probe gate: the runtime Server swaps in a hook that
         # blocks query/import dispatch (bounded) until the backend probe
         # verdict lands — True = proceed, False = serve 503 + Retry-After

@@ -41,6 +41,13 @@ class Server:
             else None
         )
         self.cluster = None
+        # deterministic fault injection (docs/fault-tolerance.md):
+        # always constructed — zero cost unarmed — so the /debug/faults
+        # route can arm rules on a live node; the cluster's outgoing
+        # client chain consults this same instance
+        from pilosa_tpu.parallel.faultinject import FaultInjector
+
+        self.fault_injector = FaultInjector.from_config(self.config)
         # per-call host/device cost router (docs/query-routing.md),
         # seeded from config; the SAME router instance survives the
         # late mesh attach so its calibration carries over
@@ -109,6 +116,8 @@ class Server:
             self.http.ssl_context = ctx
         self.http.node_id = self.config.node_id
         self.http.long_query_time = self.config.long_query_time
+        self.http.query_timeout_ms = self.config.query_timeout_ms
+        self.http.fault_injector = self.fault_injector
         self.http.log = self.logger.log
         self.http.gate = self._query_gate
         if self.config.seeds or self.config.coordinator:

@@ -88,6 +88,32 @@ class Config:
     batch_window_us: float = 250.0
     # queries per wave before an immediate flush
     batch_max_queries: int = 64
+    # fault tolerance (docs/fault-tolerance.md)
+    # per-query time budget in milliseconds (0 = unlimited): propagated
+    # across fan-out hops via X-Pilosa-Deadline-Ms with the REMAINING
+    # budget, bounding socket timeouts, retries, and wave waits;
+    # exhaustion returns HTTP 504
+    query_timeout_ms: float = 0.0
+    # extra attempts (after the first) for idempotent node→node RPCs —
+    # reads, status probes, anti-entropy pulls; never writes/imports.
+    # 0 disables retries.
+    rpc_retries: int = 2
+    # capped exponential backoff with full jitter between retries:
+    # delay ~ U(0, min(cap, base * 2^attempt))
+    rpc_backoff_base_ms: float = 20.0
+    rpc_backoff_cap_ms: float = 500.0
+    # per-peer circuit breaker: after `threshold` consecutive RPC
+    # failures the peer fast-fails (one BreakerOpenError instead of a
+    # data-plane timeout per query) until a `cooldown` half-open probe
+    # or a successful heartbeat closes it
+    breaker_enabled: bool = True
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_ms: float = 5000.0
+    # deterministic fault injection (chaos rehearsal): a JSON list of
+    # rules applied to this node's OUTGOING data-plane RPCs, seeded for
+    # reproducibility; also settable at runtime via /debug/faults
+    fault_rules: str = ""
+    fault_seed: int = 0
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -197,6 +223,15 @@ def config_template() -> str:
         'batch-mode = "adaptive"\n'
         "batch-window-us = 250.0\n"
         "batch-max-queries = 64\n"
+        "query-timeout-ms = 0.0\n"
+        "rpc-retries = 2\n"
+        "rpc-backoff-base-ms = 20.0\n"
+        "rpc-backoff-cap-ms = 500.0\n"
+        "breaker-enabled = true\n"
+        "breaker-failure-threshold = 3\n"
+        "breaker-cooldown-ms = 5000.0\n"
+        'fault-rules = ""\n'
+        "fault-seed = 0\n"
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

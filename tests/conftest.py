@@ -45,6 +45,13 @@ def pytest_configure(config):
         "(tests/test_scheduler.py; runs in tier-1 — the marker exists so "
         "`pytest -m batching` scopes to it)",
     )
+    config.addinivalue_line(
+        "markers",
+        "faults: fault-tolerance chaos suite — seeded fault injection, "
+        "retry/failover/breaker/deadline behavior (tests/test_faults.py; "
+        "runs in tier-1 — the marker exists so `pytest -m faults` scopes "
+        "to it)",
+    )
 
 
 @pytest.fixture

@@ -1,0 +1,11 @@
+"""Seeded violation for the ``resilience`` rule: a data-plane call site
+constructing the raw transport directly — bypassing retries, circuit
+breakers, deadline propagation AND fault injection (the chaos suite
+silently stops covering this path)."""
+
+from pilosa_tpu.parallel.client import InternalClient
+
+
+def naked_read(uri: str, index: str):
+    client = InternalClient(timeout=5.0)  # <- naked transport: must flag
+    return client.query_node(uri, index, "Count(Row(f=1))", None)

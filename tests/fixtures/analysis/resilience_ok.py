@@ -1,0 +1,10 @@
+"""Clean twin of resilience_bad.py: the same read goes through the
+resilient wrapper factory — retries, breaker gate, deadline
+propagation, and fault injection all apply."""
+
+from pilosa_tpu.parallel.resilience import make_resilient_client
+
+
+def resilient_read(config, stats, uri: str, index: str):
+    client = make_resilient_client(config, stats=stats)
+    return client.query_node(uri, index, "Count(Row(f=1))", None)

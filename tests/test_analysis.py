@@ -74,6 +74,7 @@ def test_rule_registry_complete():
         "broad-except",
         "mutable-default",
         "wall-clock",
+        "resilience",
     ):
         assert name in out, f"rule {name} missing from registry"
 
@@ -88,6 +89,7 @@ def test_rule_registry_complete():
             "banned_bad.py",
             ["bare-except", "broad-except", "mutable-default", "wall-clock"],
         ),
+        ("resilience_bad.py", ["resilience"]),
     ],
 )
 def test_seeded_fixture_fails(fixture, rules):
@@ -98,7 +100,8 @@ def test_seeded_fixture_fails(fixture, rules):
 
 
 @pytest.mark.parametrize(
-    "fixture", ["readback_ok.py", "locks_ok.py", "banned_ok.py"]
+    "fixture",
+    ["readback_ok.py", "locks_ok.py", "banned_ok.py", "resilience_ok.py"],
 )
 def test_clean_fixture_passes(fixture):
     rc, out = run_analyzer(str(FIXTURES / fixture))
@@ -329,6 +332,47 @@ def test_readback_leak_in_server_fails(tree_copy):
     rc, out = check_tree(tree_copy)
     assert rc != 0
     assert "[readback]" in out
+
+
+def test_resilience_naked_transport_fails(tree_copy):
+    # the cluster constructing the raw transport directly: retries,
+    # breakers, deadlines and fault injection all silently vanish from
+    # the whole distributed read path
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        "self.client = make_resilient_client(",
+        "self.client = InternalClient(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[resilience]" in out and "InternalClient" in out
+
+
+def test_resilience_write_in_retry_scope_fails(tree_copy):
+    # a write RPC migrating into the retry set = duplicated writes on
+    # transient failures; the rule reads the literal sets structurally
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "resilience.py",
+        '        "query_node",\n        "query_batch_node",',
+        '        "query_node",\n        "import_node",\n'
+        '        "query_batch_node",',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[resilience]" in out and "import_node" in out
+
+
+def test_resilience_unflagged_write_leg_fails(tree_copy):
+    # the write router dropping write=True would put Set/Clear legs on
+    # the retried, coalesced read RPC
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        "write=True,",
+        "write=False,",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[resilience]" in out and "write=True" in out
 
 
 # ----------------------------------------------------------------- fixes

@@ -1,0 +1,617 @@
+"""Chaos suite for the fault-tolerant distributed query path
+(docs/fault-tolerance.md).
+
+Everything here runs against SEEDED, deterministic fault rules
+(parallel/faultinject.py) — no real network chaos: retry-then-succeed,
+in-query replica failover with result equivalence across every read
+call type, breaker open/half-open/close transitions on a fake clock,
+deadline exhaustion as the labeled 504, writes-never-retried, and the
+partial-results annotation shape."""
+
+import json
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.parallel.client import BreakerOpenError, PeerError
+from pilosa_tpu.parallel.faultinject import FaultInjector
+from pilosa_tpu.parallel.resilience import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BreakerRegistry,
+    CircuitBreaker,
+    Deadline,
+    DeadlineExceededError,
+    QueryContext,
+    ResilientClient,
+    RetryPolicy,
+    use_query_context,
+)
+from pilosa_tpu.server import Server
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils.config import Config
+
+pytestmark = pytest.mark.faults
+
+
+# ------------------------------------------------------------ harness
+def free_ports(n):
+    socks = []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def make_cluster(tmp_path, n=2, replica_n=1, **extra):
+    ports = free_ports(n)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(n):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            replica_n=replica_n,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+            **extra,
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    for s in servers:
+        s.cluster._heartbeat_once()
+    return servers, ports
+
+
+def call(port, body, path="/index/i/query"):
+    data = body if isinstance(body, (bytes, type(None))) else json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method="POST"
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        return json.loads(resp.read())
+
+
+def shutdown(servers):
+    for s in servers:
+        if s is not None:
+            s.close()
+
+
+def seed_data(port, n_shards=16, rows_mod=3):
+    call(port, {}, path="/index/i")
+    call(port, {}, path="/index/i/field/f")
+    call(port, {"options": {"type": "int"}}, path="/index/i/field/v")
+    cols = [s * SHARD_WIDTH + o for s in range(n_shards) for o in (1, 2, 3)]
+    rows = [(c // SHARD_WIDTH) % rows_mod + 1 for c in cols]
+    call(port, {"rowIDs": rows, "columnIDs": cols},
+         path="/index/i/field/f/import")
+    call(port, {"columnIDs": cols, "values": list(range(len(cols)))},
+         path="/index/i/field/v/import-value")
+    return cols, rows
+
+
+def revive(server):
+    """Re-mark every peer alive (undo dead-marks) so each probe of the
+    failover path starts from 'heartbeat says healthy'."""
+    for node in server.cluster.nodes:
+        node.alive = True
+
+
+def routed_victim(server, index="i", n_shards=16):
+    """The remote peer the coordinator's read routing actually picks
+    for at least one shard — blackholing a hardcoded peer would be
+    flaky (placement hashes the ephemeral port-derived node ids)."""
+    cl = server.cluster
+    holdings = cl._read_holdings(index)
+    for s in range(n_shards):
+        picked = cl._pick_read_node(index, s, holdings)
+        if picked is not None and picked.id != cl.me.id:
+            return picked
+    raise AssertionError("read routing never leaves the coordinator")
+
+
+def counters(server):
+    return server.stats.expvar()["counters"]
+
+
+# every distributed read call type (failover must be result-equivalent
+# on each: counts add, segments concatenate, TopN/GroupBy merge by key)
+READ_QUERIES = [
+    b"Row(f=1)",
+    b"Count(Row(f=1))",
+    b"Count(Intersect(Row(f=1), Row(f=2)))",
+    b"Count(Union(Row(f=1), Row(f=3)))",
+    b"Count(Difference(Row(f=1), Row(f=2)))",
+    b"TopN(f, n=3)",
+    b"Rows(f)",
+    b"GroupBy(Rows(f))",
+    b"Sum(field=v)",
+    b"Min(field=v)",
+    b"Max(field=v)",
+]
+
+
+# ------------------------------------------------- classification unit
+def test_peer_error_status_classification():
+    assert PeerError("http://p", "connection refused").retryable
+    assert PeerError("http://p", "HTTP 503: busy", status=503).retryable
+    assert PeerError("http://p", "HTTP 500: boom", status=500).retryable
+    assert not PeerError("http://p", "HTTP 400: bad pql", status=400).retryable
+    assert not PeerError("http://p", "HTTP 404: gone", status=404).retryable
+    # breaker fast-fails are retryable by classification: the cluster
+    # fails the leg over to a replica instead of erroring the query
+    assert BreakerOpenError("http://p", "open").retryable
+
+
+def test_retry_policy_full_jitter_bounds_and_determinism():
+    import random
+
+    p1 = RetryPolicy(retries=3, base_s=0.02, cap_s=0.5, rng=random.Random(7))
+    p2 = RetryPolicy(retries=3, base_s=0.02, cap_s=0.5, rng=random.Random(7))
+    d1 = [p1.backoff(a) for a in range(6)]
+    d2 = [p2.backoff(a) for a in range(6)]
+    assert d1 == d2, "seeded policies must draw identical jitter"
+    for a, d in enumerate(d1):
+        assert 0.0 <= d <= min(0.5, 0.02 * 2 ** a)
+    # the cap holds even for huge attempt numbers
+    assert p1.backoff(40) <= 0.5
+
+
+# ----------------------------------------------------- breaker machine
+def test_breaker_open_half_open_close_transitions():
+    t = [0.0]
+    br = CircuitBreaker(threshold=2, cooldown_s=5.0, clock=lambda: t[0])
+    assert br.state == BREAKER_CLOSED and br.allow()
+    assert br.record_failure() == BREAKER_CLOSED
+    assert br.allow(), "below threshold stays closed"
+    assert br.record_failure() == BREAKER_OPEN
+    assert not br.allow(), "open fast-fails"
+    t[0] = 4.99
+    assert not br.allow(), "cooldown not elapsed"
+    t[0] = 5.01
+    assert br.state == BREAKER_HALF_OPEN
+    assert br.allow(), "half-open admits exactly one trial"
+    assert not br.allow(), "second concurrent trial denied"
+    assert br.record_failure() == BREAKER_OPEN, "failed trial re-opens"
+    assert not br.allow()
+    t[0] = 10.5
+    assert br.allow(), "fresh cooldown elapsed — next trial"
+    assert br.record_success() == BREAKER_CLOSED
+    assert br.allow() and br.allow(), "closed admits everyone again"
+
+
+class _ScriptedInner:
+    """Duck-typed InternalClient stand-in: each method pops the next
+    scripted outcome (exception → raised, value → returned)."""
+
+    def __init__(self, script):
+        self.script = list(script)
+        self.calls = []
+
+    def _next(self, name, uri):
+        self.calls.append((name, uri))
+        out = self.script.pop(0)
+        if isinstance(out, Exception):
+            raise out
+        return out
+
+    def query_node(self, uri, index, pql, shards):
+        return self._next("query_node", uri)
+
+    def import_node(self, uri, index, field, payload, values):
+        return self._next("import_node", uri)
+
+    def status(self, uri, timeout=None):
+        return self._next("status", uri)
+
+
+def _client(script, retries=2, threshold=3, clock=None):
+    sleeps = []
+    inner = _ScriptedInner(script)
+    rc = ResilientClient(
+        inner,
+        BreakerRegistry(
+            threshold=threshold,
+            cooldown_s=100.0,
+            clock=clock or time.monotonic,
+        ),
+        RetryPolicy(retries=retries, sleep=sleeps.append),
+    )
+    return rc, inner, sleeps
+
+
+def test_resilient_client_retries_then_succeeds():
+    rc, inner, sleeps = _client(
+        [PeerError("u", "HTTP 503: x", status=503), ["ok"]]
+    )
+    assert rc.query_node("u", "i", "Count(Row(f=1))", None) == ["ok"]
+    assert len(inner.calls) == 2 and len(sleeps) == 1
+
+
+def test_resilient_client_gives_up_after_retry_budget():
+    errs = [PeerError("u", "reset") for _ in range(3)]
+    rc, inner, _ = _client(errs, retries=2)
+    with pytest.raises(PeerError):
+        rc.query_node("u", "i", "q", None)
+    assert len(inner.calls) == 3, "1 attempt + 2 retries"
+
+
+def test_resilient_client_permanent_error_not_retried():
+    rc, inner, sleeps = _client(
+        [PeerError("u", "HTTP 400: bad", status=400), ["never"]]
+    )
+    with pytest.raises(PeerError):
+        rc.query_node("u", "i", "q", None)
+    assert len(inner.calls) == 1 and not sleeps
+
+
+def test_resilient_client_never_retries_writes():
+    rc, inner, sleeps = _client(
+        [PeerError("u", "HTTP 500: mid-write", status=500), ["never"]]
+    )
+    with pytest.raises(PeerError):
+        rc.import_node("u", "i", "f", {}, False)
+    assert len(inner.calls) == 1 and not sleeps
+    # the single-shot query RPC (write fan-out legs) is equally exempt
+    rc2, inner2, sleeps2 = _client(
+        [PeerError("u", "HTTP 500: mid-write", status=500), ["never"]]
+    )
+    with pytest.raises(PeerError):
+        rc2.query_node_once("u", "i", "Set(1, f=1)", [0])
+    assert len(inner2.calls) == 1 and not sleeps2
+
+
+def test_resilient_client_breaker_fast_fails_then_status_closes():
+    t = [0.0]
+    rc, inner, _ = _client(
+        [PeerError("u", "reset"), PeerError("u", "reset"), {"state": "NORMAL"},
+         ["ok"]],
+        retries=0,
+        threshold=2,
+        clock=lambda: t[0],
+    )
+    for _ in range(2):
+        with pytest.raises(PeerError):
+            rc.query_node("u", "i", "q", None)
+    # breaker open: fast-fail, the inner client is NOT touched
+    with pytest.raises(BreakerOpenError):
+        rc.query_node("u", "i", "q", None)
+    assert len(inner.calls) == 2
+    # the liveness probe bypasses the gate and its success closes the
+    # breaker (heartbeat integration) — the next read goes through
+    rc.status("u")
+    assert rc.query_node("u", "i", "q", None) == ["ok"]
+
+
+# ----------------------------------------------------- fault injector
+def test_fault_rules_first_n_then_ok():
+    inj = FaultInjector(
+        [{"path": "/internal/query", "action": "http", "status": 503,
+          "times": 2}],
+        seed=1,
+    )
+    for _ in range(2):
+        with pytest.raises(PeerError) as ei:
+            inj.before_request("POST", "http://x", "/internal/query")
+        assert ei.value.status == 503
+    inj.before_request("POST", "http://x", "/internal/query")  # now ok
+    assert inj.snapshot()["rules"][0]["fires"] == 2
+    # non-matching path never fires
+    inj.before_request("GET", "http://x", "/status")
+    assert inj.snapshot()["rules"][0]["fires"] == 2
+
+
+def test_fault_delay_jitter_is_seeded():
+    spec = [{"action": "delay", "delay_ms": 5.0, "jitter_ms": 10.0}]
+    rec1, rec2 = [], []
+    inj1 = FaultInjector(list(spec), seed=42, sleep=rec1.append)
+    inj2 = FaultInjector(list(spec), seed=42, sleep=rec2.append)
+    for _ in range(5):
+        inj1.before_request("GET", "u", "/p")
+        inj2.before_request("GET", "u", "/p")
+    assert rec1 == rec2, "same seed, same chaos"
+    assert all(0.005 <= d <= 0.015 for d in rec1)
+
+
+def test_blackhole_fails_until_cleared():
+    inj = FaultInjector([{"action": "blackhole", "times": 1}], seed=0)
+    for _ in range(5):  # `times` is ignored by blackhole
+        with pytest.raises(PeerError):
+            inj.before_request("POST", "u", "/internal/query")
+    inj.clear()
+    inj.before_request("POST", "u", "/internal/query")
+
+
+# -------------------------------------------------- deadline machinery
+def test_deadline_countdown_and_label():
+    t = [0.0]
+    d = Deadline(0.25, clock=lambda: t[0])
+    assert not d.expired() and abs(d.remaining() - 0.25) < 1e-9
+    t[0] = 0.3
+    assert d.expired()
+    err = d.exceeded("unit test")
+    assert isinstance(err, DeadlineExceededError)
+    assert "deadline exceeded" in str(err) and "250ms" in str(err)
+
+
+def test_scheduler_rejects_expired_deadline():
+    from pilosa_tpu.executor.scheduler import WaveScheduler
+
+    sched = WaveScheduler(lambda: None, mode="off")
+    with use_query_context(QueryContext(deadline=Deadline(0.0))):
+        with pytest.raises(DeadlineExceededError):
+            sched.execute("i", [], shards=None)
+
+
+def test_scheduler_window_bounded_by_deadline():
+    from pilosa_tpu.executor.scheduler import WaveScheduler
+
+    sched = WaveScheduler(lambda: None, mode="always", window_us=500_000)
+    assert sched._window_seconds(None, 2) == pytest.approx(0.5)
+    with use_query_context(QueryContext(deadline=Deadline(0.05))):
+        assert sched._window_seconds(None, 2) <= 0.05
+    with use_query_context(QueryContext(deadline=Deadline(0.0))):
+        assert sched._window_seconds(None, 2) == 0.0
+
+
+# ------------------------------------------------------- cluster chaos
+def test_retry_then_succeed_first_rpc_faulted(tmp_path):
+    """Seeded first-N-then-ok fault on the fan-out RPC: the read
+    retries the same peer and returns the fault-free answer."""
+    servers, ports = make_cluster(
+        tmp_path, n=2, replica_n=1, heartbeat_interval=60.0
+    )
+    try:
+        seed_data(ports[0])
+        expected = call(ports[0], b"Count(Row(f=1))")["results"]
+        servers[0].fault_injector.set_rules(
+            [{"path": "/internal/query", "action": "http", "status": 503,
+              "times": 1}],
+            seed=3,
+        )
+        got = call(ports[0], b"Count(Row(f=1))")["results"]
+        assert got == expected
+        assert servers[0].fault_injector.snapshot()["rules"][0]["fires"] == 1
+        assert counters(servers[0]).get(
+            "rpc_retries{method=query_node}", 0
+        ) >= 1
+    finally:
+        shutdown(servers)
+
+
+def test_failover_result_equivalence_every_call_type(tmp_path):
+    """With one peer blackholed, every distributed read call type must
+    return results identical to the fault-free run — legs re-plan onto
+    the surviving replica owner mid-query instead of erroring."""
+    servers, ports = make_cluster(
+        tmp_path, n=3, replica_n=2, heartbeat_interval=60.0, rpc_retries=0
+    )
+    try:
+        seed_data(ports[0])
+        expected = {q: call(ports[0], q)["results"] for q in READ_QUERIES}
+        victim = routed_victim(servers[0])
+        servers[0].fault_injector.set_rules(
+            [{"peer": victim.id, "path": "/internal/",
+              "action": "blackhole"}],
+            seed=5,
+        )
+        for q in READ_QUERIES:
+            revive(servers[0])  # each call type starts from 'healthy'
+            assert call(ports[0], q)["results"] == expected[q], q
+        assert counters(servers[0]).get("legs_failed_over", 0) >= 1
+    finally:
+        shutdown(servers)
+
+
+def test_breaker_caps_blackholed_peer_to_one_fast_fail(tmp_path):
+    """Acceptance: with a peer fully blackholed (simulated data-plane
+    hang via injected delay), the breaker caps per-query added latency
+    to one fast-fail — no repeated data-plane timeout even when the
+    heartbeat still reports the peer alive."""
+    delay_ms = 800.0
+    servers, ports = make_cluster(
+        tmp_path,
+        n=3,
+        replica_n=2,
+        heartbeat_interval=60.0,
+        rpc_retries=0,
+        breaker_failure_threshold=1,
+        breaker_cooldown_ms=60_000.0,
+    )
+    try:
+        seed_data(ports[0])
+        q = b"Count(Row(f=1))"
+        expected = call(ports[0], q)["results"]  # also warms the program
+        victim = routed_victim(servers[0])
+        servers[0].fault_injector.set_rules(
+            [{"peer": victim.id, "path": "/internal/",
+              "action": "blackhole", "delay_ms": delay_ms}],
+            seed=9,
+        )
+        # first query pays the simulated timeout once and trips the
+        # breaker (threshold 1); answer still correct via failover
+        assert call(ports[0], q)["results"] == expected
+        fires = servers[0].fault_injector.snapshot()["rules"][0]["fires"]
+        assert fires >= 1
+        # peer 'recovers' in heartbeat terms — but the breaker is open
+        revive(servers[0])
+        t0 = time.perf_counter()
+        assert call(ports[0], q)["results"] == expected
+        dt = time.perf_counter() - t0
+        assert dt < delay_ms / 1e3 * 0.75, (
+            f"breaker-open query took {dt:.3f}s — it paid the data-plane "
+            "timeout instead of one fast-fail"
+        )
+        # no new data-plane round trip reached the blackholed peer
+        assert (
+            servers[0].fault_injector.snapshot()["rules"][0]["fires"] == fires
+        )
+    finally:
+        shutdown(servers)
+
+
+def test_deadline_exhaustion_returns_labeled_504(tmp_path):
+    servers, ports = make_cluster(
+        tmp_path,
+        n=2,
+        replica_n=1,
+        heartbeat_interval=60.0,
+        rpc_retries=0,
+        query_timeout_ms=150.0,
+    )
+    try:
+        seed_data(ports[0])
+        servers[0].fault_injector.set_rules(
+            [{"path": "/internal/query", "action": "delay",
+              "delay_ms": 400.0}],
+            seed=11,
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            call(ports[0], b"Count(Row(f=1))")
+        assert ei.value.code == 504
+        body = json.loads(ei.value.read())
+        assert "deadline exceeded" in body["error"]
+    finally:
+        shutdown(servers)
+
+
+def test_writes_are_never_retried(tmp_path):
+    servers, ports = make_cluster(
+        tmp_path, n=2, replica_n=1, heartbeat_interval=60.0
+    )
+    try:
+        call(ports[0], {}, path="/index/i")
+        call(ports[0], {}, path="/index/i/field/f")
+        me = servers[0].cluster.me.id
+        peer_shard = next(
+            s for s in range(32)
+            if servers[0].cluster.shard_nodes("i", s)[0].id != me
+        )
+        col = peer_shard * SHARD_WIDTH + 1
+        servers[0].fault_injector.set_rules(
+            [{"path": "/internal/query", "action": "http", "status": 500,
+              "times": 1}],
+            seed=13,
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            call(ports[0], f"Set({col}, f=1)".encode())
+        # exactly ONE attempt reached the wire: the faulted RPC was not
+        # replayed (a retried write is a duplicated write)
+        assert servers[0].fault_injector.snapshot()["rules"][0]["fires"] == 1
+        assert "rpc_retries{method=query_node}" not in counters(servers[0])
+        # the write did not land anywhere
+        assert call(ports[0], b"Count(Row(f=1))")["results"] == [0]
+        # the client's own retry (rules exhausted) succeeds normally
+        assert call(ports[0], f"Set({col}, f=1)".encode())["results"] == [True]
+        assert call(ports[0], b"Count(Row(f=1))")["results"] == [1]
+    finally:
+        shutdown(servers)
+
+
+def test_allow_partial_annotation_shape(tmp_path):
+    """No surviving replica: default is a loud 503; ?allow-partial=true
+    returns the surviving shards' results plus a partialShards
+    annotation naming exactly the lost ones."""
+    n_shards = 16
+    servers, ports = make_cluster(
+        tmp_path, n=2, replica_n=1, heartbeat_interval=60.0, rpc_retries=0
+    )
+    try:
+        seed_data(ports[0], n_shards=n_shards, rows_mod=1)  # every row id 1
+        me = servers[0].cluster.me.id
+        peer_shards = sorted(
+            s for s in range(n_shards)
+            if servers[0].cluster.shard_nodes("i", s)[0].id != me
+        )
+        assert peer_shards, "placement must span both nodes"
+        servers[0].fault_injector.set_rules(
+            [{"peer": f"127.0.0.1:{ports[1]}", "path": "/internal/query",
+              "action": "blackhole"}],
+            seed=17,
+        )
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            call(ports[0], b"Count(Row(f=1))")
+        assert ei.value.code == 503
+        revive(servers[0])
+        resp = call(
+            ports[0], b"Count(Row(f=1))",
+            path="/index/i/query?allow-partial=true",
+        )
+        assert resp["partialShards"] == peer_shards
+        assert resp["results"] == [3 * (n_shards - len(peer_shards))]
+        assert counters(servers[0]).get("queries_partial", 0) >= 1
+    finally:
+        shutdown(servers)
+
+
+def test_heartbeat_probes_peers_concurrently(tmp_path):
+    """One hung peer must not stretch the heartbeat by its timeout times
+    the peer count: /status probes fan out concurrently (delay-faulted
+    probes overlap — the injector records the high-water mark)."""
+    ports = free_ports(4)
+    cfg = Config(
+        bind=f"127.0.0.1:{ports[0]}",
+        data_dir=str(tmp_path / "hb"),
+        seeds=[f"http://127.0.0.1:{p}" for p in ports],
+        coordinator=True,
+        anti_entropy_interval=0,
+        heartbeat_interval=60.0,
+        rpc_retries=0,
+    )
+    s = Server(cfg)
+    s.open()
+    try:
+        s.fault_injector.set_rules(
+            [{"path": "/status", "action": "delay", "delay_ms": 300.0}],
+            seed=19,
+        )
+        t0 = time.perf_counter()
+        s.cluster._heartbeat_once()
+        dt = time.perf_counter() - t0
+        assert s.fault_injector.max_concurrent >= 2, (
+            "status probes ran serially"
+        )
+        assert dt < 0.85, f"heartbeat took {dt:.2f}s — serial probe times"
+    finally:
+        s.close()
+
+
+def test_debug_faults_route_roundtrip(tmp_path):
+    port = free_ports(1)[0]
+    s = Server(Config(bind=f"127.0.0.1:{port}", data_dir=str(tmp_path / "d")))
+    s.open()
+    try:
+        rules = [{"peer": "127.0.0.1:9", "action": "http", "status": 502,
+                  "times": 3}]
+        out = call(port, {"rules": rules, "seed": 21}, path="/debug/faults")
+        assert out["success"] and out["rules"] == 1
+        snap = get(port, "/debug/faults")
+        assert snap["seed"] == 21
+        assert snap["rules"][0]["status"] == 502
+        assert snap["rules"][0]["fires"] == 0
+        # the route drives the SAME injector the node's client consults
+        assert s.fault_injector.armed
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/debug/faults", method="DELETE"
+        )
+        urllib.request.urlopen(req).read()
+        assert get(port, "/debug/faults")["rules"] == []
+        assert not s.fault_injector.armed
+    finally:
+        s.close()

@@ -8,4 +8,5 @@ from tools.analysis.rules import (  # noqa: F401
     observability,
     parity,
     readback,
+    resilience,
 )

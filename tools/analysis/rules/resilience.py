@@ -1,0 +1,265 @@
+"""Resilient-RPC discipline.
+
+The fault-tolerance PR's contract (docs/fault-tolerance.md): every
+node→node data-plane call site outside the transport layer goes through
+the resilient wrapper — retry + circuit breaker + deadline — and writes
+never enter a retry scope (a replayed Set/Clear/import is a duplicated
+write).  Enforced structurally:
+
+1. **no naked transport** — ``InternalClient(...)`` may be constructed
+   only in ``parallel/client.py`` (the transport itself),
+   ``parallel/resilience.py`` (the wrapper factory) and
+   ``parallel/faultinject.py`` (the injection subclass).  Anywhere else
+   it bypasses retries, breakers, deadline propagation AND fault
+   injection — the chaos suite would silently stop covering that path;
+2. **no raw urlopen on the data plane** — files under ``parallel/``
+   other than client.py must not call ``urlopen`` directly (same
+   bypass, one layer lower);
+3. **retry/write separation** — ``parallel/resilience.py`` must declare
+   ``RETRYABLE_METHODS`` and ``WRITE_METHODS`` as literal sets, keep
+   them disjoint, keep every canonical write RPC (import_node,
+   import_roaring, set_attrs, send_schema, remove_node,
+   query_node_once) out of the retry scope, and keep the canonical
+   idempotent reads (query_node, query_batch_node) IN it —
+   deleting the retry coverage is as much a regression as widening it;
+4. **write legs stay single-shot** — in ``parallel/cluster.py``, the
+   write routers (``_route_write``/``_route_attr_write``) must pass
+   ``write=True`` on every ``_timed_query_node`` leg (the flag that
+   routes around both the leg coalescer and the retry scope) and must
+   never call the retried ``query_node``/``query_batch_node`` RPCs
+   directly.
+
+Files are located by project-relative suffix so tests can run the rule
+against fixtures and mutated copies of the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, call_name, rule
+
+CLIENT = "parallel/client.py"
+RESILIENCE = "parallel/resilience.py"
+FAULTINJECT = "parallel/faultinject.py"
+CLUSTER = "parallel/cluster.py"
+
+# construction of the raw transport is allowed only in these files
+_TRANSPORT_FILES = (CLIENT, RESILIENCE, FAULTINJECT)
+
+_CANONICAL_WRITES = frozenset({
+    "query_node_once",
+    "import_node",
+    "import_roaring",
+    "set_attrs",
+    "send_schema",
+    "remove_node",
+})
+# status is deliberately absent: the liveness probe is single-shot (the
+# heartbeat cadence is its retry loop — see parallel/resilience.py)
+_CANONICAL_READS = frozenset({"query_node", "query_batch_node"})
+
+_WRITE_ROUTERS = ("_route_write", "_route_attr_write")
+
+
+def _last_segment(dotted: str) -> str:
+    return dotted.rsplit(".", 1)[-1]
+
+
+def _literal_str_set(node: ast.AST) -> set[str] | None:
+    """The string elements of a set/frozenset/tuple/list literal (also
+    unwrapping ``frozenset({...})``), or None when not a literal."""
+    if isinstance(node, ast.Call) and _last_segment(
+        call_name(node.func)
+    ) in ("frozenset", "set") and node.args:
+        return _literal_str_set(node.args[0])
+    if isinstance(node, (ast.Set, ast.Tuple, ast.List)):
+        out: set[str] = set()
+        for elt in node.elts:
+            if not (
+                isinstance(elt, ast.Constant) and isinstance(elt.value, str)
+            ):
+                return None
+            out.add(elt.value)
+        return out
+    return None
+
+
+def _method_sets(tree: ast.Module) -> dict[str, tuple[set[str], int]]:
+    """{name: (elements, line)} for RETRYABLE_METHODS / WRITE_METHODS
+    assignments anywhere in the file (class-level included)."""
+    found: dict[str, tuple[set[str], int]] = {}
+    for node in ast.walk(tree):
+        targets: list[ast.expr] = []
+        value: ast.expr | None = None
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id in (
+                "RETRYABLE_METHODS",
+                "WRITE_METHODS",
+            ):
+                elems = _literal_str_set(value)
+                if elems is not None:
+                    found[t.id] = (elems, node.lineno)
+    return found
+
+
+@rule(
+    "resilience",
+    "data-plane RPCs route through the resilient wrapper; writes never retry",
+)
+def check_resilience(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+
+    # 1 + 2: naked transport construction / raw urlopen on the data plane
+    for f in project.files:
+        if f.tree is None:
+            continue
+        exempt_client = any(
+            f.rel == s or f.rel.endswith("/" + s) for s in _TRANSPORT_FILES
+        )
+        in_parallel = "parallel/" in f.rel or f.rel.startswith("parallel")
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _last_segment(call_name(node.func))
+            if name == "InternalClient" and not exempt_client:
+                out.append(
+                    Violation(
+                        "resilience",
+                        f.rel,
+                        node.lineno,
+                        "naked InternalClient construction bypasses the "
+                        "resilient wrapper (retries, breakers, deadlines, "
+                        "fault injection) — use "
+                        "resilience.make_resilient_client",
+                    )
+                )
+            elif (
+                name == "urlopen"
+                and in_parallel
+                and not (
+                    f.rel == CLIENT or f.rel.endswith("/" + CLIENT)
+                )
+            ):
+                out.append(
+                    Violation(
+                        "resilience",
+                        f.rel,
+                        node.lineno,
+                        "raw urlopen on the data plane bypasses the "
+                        "resilient client chain — go through "
+                        "InternalClient (parallel/client.py)",
+                    )
+                )
+
+    # 3: retry/write separation in the wrapper
+    res = project.find(RESILIENCE)
+    if res is not None and res.tree is not None:
+        sets = _method_sets(res.tree)
+        for required in ("RETRYABLE_METHODS", "WRITE_METHODS"):
+            if required not in sets:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        1,
+                        f"{required} literal set missing from the resilient "
+                        "wrapper — the retry/write separation is unverifiable",
+                    )
+                )
+        if "RETRYABLE_METHODS" in sets and "WRITE_METHODS" in sets:
+            retryable, r_line = sets["RETRYABLE_METHODS"]
+            writes, w_line = sets["WRITE_METHODS"]
+            overlap = sorted(retryable & writes)
+            if overlap:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        r_line,
+                        f"methods {overlap} appear in BOTH the retry scope "
+                        "and the write set — a retried write is a "
+                        "duplicated write",
+                    )
+                )
+            leaked = sorted(_CANONICAL_WRITES & retryable)
+            if leaked:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        r_line,
+                        f"write RPC(s) {leaked} in RETRYABLE_METHODS — "
+                        "writes must never be retried",
+                    )
+                )
+            missing_w = sorted(_CANONICAL_WRITES - writes - retryable)
+            if missing_w:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        w_line,
+                        f"write RPC(s) {missing_w} missing from "
+                        "WRITE_METHODS — they would be unclassified",
+                    )
+                )
+            missing_r = sorted(_CANONICAL_READS - retryable)
+            if missing_r:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        r_line,
+                        f"idempotent read(s) {missing_r} missing from "
+                        "RETRYABLE_METHODS — transient faults would fail "
+                        "whole queries",
+                    )
+                )
+
+    # 4: write routers stay outside the retry scope
+    cluster = project.find(CLUSTER)
+    if cluster is not None and cluster.tree is not None:
+        for node in ast.walk(cluster.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name not in _WRITE_ROUTERS:
+                continue
+            for c in ast.walk(node):
+                if not isinstance(c, ast.Call):
+                    continue
+                name = _last_segment(call_name(c.func))
+                if name == "_timed_query_node":
+                    kw = next(
+                        (k for k in c.keywords if k.arg == "write"), None
+                    )
+                    if kw is None or not (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is True
+                    ):
+                        out.append(
+                            Violation(
+                                "resilience",
+                                cluster.rel,
+                                c.lineno,
+                                f"{node.name}() sends a fan-out leg without "
+                                "write=True — the write would ride the "
+                                "retried/coalesced read RPC",
+                            )
+                        )
+                elif name in ("query_node", "query_batch_node"):
+                    out.append(
+                        Violation(
+                            "resilience",
+                            cluster.rel,
+                            c.lineno,
+                            f"{node.name}() calls the retried {name} RPC "
+                            "directly — write legs must use the "
+                            "single-shot path",
+                        )
+                    )
+    return out
