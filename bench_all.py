@@ -635,187 +635,245 @@ def config7_cluster_read():
 
 
 def config8_concurrency_sweep():
-    """ISSUE 4: sync Count/TopN/GroupBy QPS swept over REAL concurrent
-    HTTP clients (c1/c8/c32) against one server with cross-query wave
-    coalescing on — the production shape (N dashboards, each sync) that
-    the pipelined rows cannot represent. Clients issue identical
-    queries (the dashboard case: single-flight dedup + shared readback
-    waves are exactly what the scheduler ships). The server pins
-    route-mode=device: the sweep measures the device wave path — host-
-    routed work bypasses the scheduler by design, so sweeping it would
-    measure host thread scaling instead. Also emits the c1 p50
-    adaptive-vs-off latency ratio (the batching-never-hurts-solo guard)
-    and queries_per_wave_p50. Exits non-zero if c8 < c1 for any call
-    type: batching must never regress the solo path."""
+    """ISSUE 4 + ISSUE 6: sync Count/TopN/GroupBy QPS swept over REAL
+    concurrent HTTP clients (c1/c8/c32/c64) against the event-driven
+    server running in its OWN process — bench clients must not share
+    the server's GIL, or the high-concurrency points measure
+    client-side interpreter thrash instead of the front end. Clients
+    issue identical queries (the dashboard case: single-flight dedup +
+    shared readback waves are exactly what the scheduler ships). The
+    server pins route-mode=device: the sweep measures the device wave
+    path — host-routed work bypasses the scheduler by design, so
+    sweeping it would measure host thread scaling instead. Also emits
+    the c1 p50 adaptive-vs-off latency ratio (the
+    batching-never-hurts-solo guard), queries_per_wave_p50, the
+    event-vs-threaded c1 p50 ratio (the front-end-swap solo-latency
+    guard, ISSUE 6 acceptance: within 1.1x), and the serving admission
+    stats (queue-depth distribution + reject rate — a sweep that
+    quietly shed load would report inflated QPS). Exits non-zero if
+    c8 < c1 OR c32 < c8 for any call type: neither batching nor the
+    event front end may regress under fan-in."""
+    import subprocess
     import sys
     import tempfile
     import threading
     import urllib.request
 
-    from pilosa_tpu.server import Server
     from pilosa_tpu.shardwidth import SHARD_WIDTH
-    from pilosa_tpu.utils.config import Config
 
     rng = np.random.default_rng(8)
     shards = int(os.environ.get("PILOSA_BENCH_SWEEP_SHARDS", "8"))
     n = shards * SHARD_WIDTH
-    port = free_ports(1)[0]
-    srv = Server(
-        Config(
-            bind=f"127.0.0.1:{port}",
-            data_dir=tempfile.mkdtemp(),
-            route_mode="device",
-            batch_mode="adaptive",
-            # bench-only: bulk-load the sweep index in few POSTs
-            max_writes_per_request=500_000,
-        )
+    iters = int(os.environ.get("PILOSA_BENCH_SWEEP_ITERS", "30"))
+    cols = np.arange(n, dtype=np.uint64)
+    cab_rows = rng.integers(0, 256, n).astype(np.uint64)
+    pc_rows = rng.integers(1, 7, n).astype(np.uint64)
+    # representative dashboard queries: enough device work that the
+    # sweep measures wave sharing, not Python HTTP parsing (XLA
+    # releases the GIL, so waves overlap the next batch's request
+    # handling; a trivially cheap query would measure the handler)
+    queries = {
+        "count": (
+            b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
+            b" Row(cab=4), Row(cab=5), Row(cab=6)))"
+        ),
+        "topn": b"TopN(cab, n=10)",
+        "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+    }
+
+    child_src = (
+        "import sys\n"
+        "from pilosa_tpu.server import Server\n"
+        "from pilosa_tpu.utils.config import load_config\n"
+        "s = Server(load_config())\n"
+        "s.open()\n"
+        "s.wait_mesh(120)\n"
+        "print('READY', flush=True)\n"
+        "sys.stdin.read()\n"  # parent closing stdin = shutdown signal
+        "s.close()\n"
     )
-    srv.open()
-    srv.wait_mesh(60)
-    try:
 
-        def post(path, payload):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}{path}",
-                data=json.dumps(payload).encode(),
-                method="POST",
-            )
-            urllib.request.urlopen(req).read()
+    def spawn_server(port: int, serving_mode: str, batch_mode: str):
+        env = dict(os.environ)
+        env.update({
+            "PILOSA_TPU_BIND": f"127.0.0.1:{port}",
+            "PILOSA_TPU_DATA_DIR": tempfile.mkdtemp(),
+            "PILOSA_TPU_ROUTE_MODE": "device",
+            "PILOSA_TPU_BATCH_MODE": batch_mode,
+            "PILOSA_TPU_SERVING_MODE": serving_mode,
+            # bench-only: bulk-load the sweep index in few POSTs
+            "PILOSA_TPU_MAX_WRITES_PER_REQUEST": "500000",
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+            "PILOSA_TPU_DIAGNOSTICS_INTERVAL": "0",
+        })
+        child = subprocess.Popen(
+            [sys.executable, "-c", child_src],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        ready = child.stdout.readline().strip()
+        assert ready == "READY", f"sweep server child failed: {ready!r}"
+        return child
 
-        def query(body: bytes):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{port}/index/sw/query",
-                data=body,
-                method="POST",
-            )
-            with urllib.request.urlopen(req) as r:
-                return json.loads(r.read())
+    def stop_server(child) -> None:
+        try:
+            child.stdin.close()
+            child.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — bench teardown best-effort
+            child.kill()
+            child.wait(timeout=10)
 
-        post("/index/sw", {})
-        post("/index/sw/field/cab", {})
-        post("/index/sw/field/pc", {})
-        cols = np.arange(n, dtype=np.uint64)
-        cab_rows = rng.integers(0, 256, n).astype(np.uint64)
-        pc_rows = rng.integers(1, 7, n).astype(np.uint64)
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+    def query(port, body: bytes):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/sw/query",
+            data=body,
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def load_data(port, both_fields: bool = True):
+        post(port, "/index/sw", {})
+        post(port, "/index/sw/field/cab", {})
+        if both_fields:
+            post(port, "/index/sw/field/pc", {})
         for lo in range(0, n, 400_000):
             post(
+                port,
                 "/index/sw/field/cab/import",
                 {
                     "rowIDs": cab_rows[lo : lo + 400_000].tolist(),
                     "columnIDs": cols[lo : lo + 400_000].tolist(),
                 },
             )
-            post(
-                "/index/sw/field/pc/import",
-                {
-                    "rowIDs": pc_rows[lo : lo + 400_000].tolist(),
-                    "columnIDs": cols[lo : lo + 400_000].tolist(),
-                },
-            )
+            if both_fields:
+                post(
+                    port,
+                    "/index/sw/field/pc/import",
+                    {
+                        "rowIDs": pc_rows[lo : lo + 400_000].tolist(),
+                        "columnIDs": cols[lo : lo + 400_000].tolist(),
+                    },
+                )
 
-        # representative dashboard queries: enough device work that the
-        # sweep measures wave sharing, not Python HTTP parsing (XLA
-        # releases the GIL, so waves overlap the next batch's request
-        # handling; a trivially cheap query would measure the handler)
-        queries = {
-            "count": (
-                b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
-                b" Row(cab=4), Row(cab=5), Row(cab=6)))"
-            ),
-            "topn": b"TopN(cab, n=10)",
-            "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
-        }
-        iters = int(os.environ.get("PILOSA_BENCH_SWEEP_ITERS", "30"))
-
-        def agg_qps(body: bytes, conc: int, per: int) -> float:
-            import http.client
-
-            barrier = threading.Barrier(conc + 1)
-            errors: list = []
-
-            def client():
-                # one persistent (keep-alive) connection per client —
-                # real clients don't reconnect per query, and a c32
-                # connect storm would measure the TCP stack, not the
-                # server
-                conn = http.client.HTTPConnection("127.0.0.1", port)
-                barrier.wait()
-                try:
-                    for _ in range(per):
-                        conn.request("POST", "/index/sw/query", body)
-                        resp = conn.getresponse()
-                        payload = resp.read()
-                        if resp.status != 200:
-                            raise RuntimeError(
-                                f"HTTP {resp.status}: {payload[:200]!r}"
-                            )
-                except Exception as exc:  # noqa: BLE001 — re-raised below
-                    errors.append(exc)
-                finally:
-                    conn.close()
-
-            ts = [
-                threading.Thread(target=client, daemon=True)
-                for _ in range(conc)
-            ]
-            for t in ts:
-                t.start()
-            barrier.wait()
+    def c1_p50_ms(port, body: bytes) -> float:
+        for _ in range(3):
+            query(port, body)  # warm the compiled programs
+        lats = []
+        for _ in range(max(20, iters)):
             t0 = time.perf_counter()
-            for t in ts:
-                t.join()
-            dt = time.perf_counter() - t0
-            if errors:
-                raise errors[0]
-            return conc * per / dt
+            query(port, body)
+            lats.append(time.perf_counter() - t0)
+        return sorted(lats)[len(lats) // 2] * 1e3
 
-        # c1 p50 latency, batching off vs adaptive: the solo-path guard
-        # (acceptance: adaptive within 10% of off at c1). Both modes
-        # warm the same compiled programs first so jit caching never
-        # biases whichever mode measures first.
-        def c1_p50_ms(body: bytes) -> float:
-            lats = []
-            for _ in range(max(20, iters)):
-                t0 = time.perf_counter()
-                query(body)
-                lats.append(time.perf_counter() - t0)
-            return sorted(lats)[len(lats) // 2] * 1e3
+    def agg_qps(port, body: bytes, conc: int, per: int) -> float:
+        import http.client
 
-        for mode in ("off", "adaptive"):
-            srv.api.scheduler.mode = mode
-            for _ in range(3):
-                query(queries["topn"])
-        srv.api.scheduler.mode = "off"
-        off_p50 = c1_p50_ms(queries["topn"])
-        srv.api.scheduler.mode = "adaptive"
-        on_p50 = c1_p50_ms(queries["topn"])
-        ratio = on_p50 / max(off_p50, 1e-9)
-        line(
-            "sync_c1_topn_p50_adaptive_vs_off",
-            ratio,
-            "ratio",
-            1.0,
-            extra={"off_p50_ms": round(off_p50, 3), "on_p50_ms": round(on_p50, 3)},
-        )
-        failed = False
-        if ratio > 1.10:
-            # the solo-path guard is a GATE, not a datapoint: adaptive
-            # batching adding >10% to c1 p50 is the regression the
-            # acceptance criterion forbids
-            failed = True
-            line(
-                "batching_regressed_c1_latency",
-                ratio,
-                "error",
-                ratio,
-            )
+        barrier = threading.Barrier(conc + 1)
+        errors: list = []
+
+        def client():
+            # one persistent (keep-alive) connection per client —
+            # real clients don't reconnect per query, and a c32
+            # connect storm would measure the TCP stack, not the
+            # server
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            barrier.wait()
+            try:
+                for _ in range(per):
+                    conn.request("POST", "/index/sw/query", body)
+                    resp = conn.getresponse()
+                    payload = resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"HTTP {resp.status}: {payload[:200]!r}"
+                        )
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+            finally:
+                conn.close()
+
+        ts = [
+            threading.Thread(target=client, daemon=True)
+            for _ in range(conc)
+        ]
+        for t in ts:
+            t.start()
+        barrier.wait()
+        t0 = time.perf_counter()
+        for t in ts:
+            t.join()
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return conc * per / dt
+
+    failed = False
+
+    # ---- spawn all three servers up front: the c1 p50 guards compare
+    # ACROSS servers, and on shared CPU a minutes-apart comparison
+    # measures neighbor load, not the front end — interleaved rounds
+    # against live servers, min per server, is drift-robust
+    eport, oport, tport = free_ports(3)
+    esrv = spawn_server(eport, "event", "adaptive")
+    osrv = spawn_server(oport, "event", "off")
+    tsrv = spawn_server(tport, "threaded", "adaptive")
+    try:
+        load_data(eport)
+        load_data(oport, both_fields=False)
+        load_data(tport, both_fields=False)
+        p50s: dict = {eport: [], oport: [], tport: []}
+        order = [eport, oport, tport]
+        for r in range(5):
+            # rotate the measurement order each round: a fixed order
+            # would fold any drifting neighbor load into one server's
+            # minimum and bias the cross-server ratios
+            for p in order[r % 3:] + order[: r % 3]:
+                p50s[p].append(c1_p50_ms(p, queries["topn"]))
+        event_c1_topn_p50 = min(p50s[eport])
+        off_p50 = min(p50s[oport])
+        threaded_p50 = min(p50s[tport])
+    finally:
+        stop_server(osrv)
+        stop_server(tsrv)
+
+    # ---- concurrency sweep against the event front end only
+    try:
         for name, body in queries.items():
-            query(body)  # warm the program cache
-            rates = {}
-            for conc in (1, 8, 32):
-                per = max(2, iters // conc) if conc > 1 else iters
-                rates[conc] = agg_qps(body, conc, per)
-            for conc in (1, 8, 32):
+            query(eport, body)  # warm the program cache
+
+            def point(conc: int) -> float:
+                # ≥8 queries per client: a 2-query-per-client point is
+                # a ~100ms sample whose noise can trip the gates below
+                per = max(8, iters // conc) if conc > 1 else iters
+                return agg_qps(eport, body, conc, per)
+
+            rates = {
+                conc: max(point(conc) for _ in range(2))
+                for conc in (1, 8, 32, 64)
+            }
+            # gates compare points measured minutes apart on shared
+            # CPU: confirm a failure back-to-back before declaring a
+            # regression — a genuine one reproduces, neighbor-load
+            # noise does not
+            if rates[8] < rates[1]:
+                rates[1] = max(rates[1], point(1))
+                rates[8] = max(rates[8], point(8))
+            if rates[32] < rates[8]:
+                rates[8] = max(rates[8], point(8))
+                rates[32] = max(rates[32], point(32))
+            for conc in (1, 8, 32, 64):
                 line(
                     f"sync_{name}_qps_c{conc}",
                     rates[conc],
@@ -830,18 +888,103 @@ def config8_concurrency_sweep():
                     "error",
                     rates[8] / max(rates[1], 1e-9),
                 )
-        qpw = srv.stats.distribution("queries_per_wave")
+            if rates[32] < rates[8]:
+                # ISSUE 6 gate: the event front end exists to break the
+                # c32 plateau — any shape whose c32 falls below c8 is
+                # the regression this sweep guards against
+                failed = True
+                line(
+                    f"serving_regressed_{name}_c32_below_c8",
+                    rates[32] / max(rates[8], 1e-9),
+                    "error",
+                    rates[32] / max(rates[8], 1e-9),
+                )
+        # scheduler + serving stats come over the wire now (the server
+        # is out-of-process): /debug/vars carries the distribution
+        # snapshots and the admission state (docs/serving.md)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{eport}/debug/vars"
+        ) as r:
+            dv = json.loads(r.read())
+        dists = dv.get("distributions", {})
         line(
             "queries_per_wave_p50",
-            qpw.percentile(0.5) if qpw is not None else 1.0,
+            float(dists.get("queries_per_wave", {}).get("p50", 1.0)),
             "queries",
             1.0,
+            extra={"queryBatching": dv.get("queryBatching", {})},
+        )
+        rejected = {
+            k.split("reason=", 1)[1].rstrip("}"): int(v)
+            for k, v in dv["counters"].items()
+            if k.startswith("queries_rejected")
+        }
+        qd = dists.get("admission_queue_depth{class=query}", {})
+        served = sum(
+            int(v)
+            for k, v in dv["counters"].items()
+            if k.startswith("http_requests")
+        )
+        line(
+            "serving_rejected_total",
+            float(sum(rejected.values())),
+            "requests",
+            1.0,
             extra={
-                "queryBatching": srv.api.scheduler.snapshot(),
+                "rejectedByReason": rejected,
+                "rejectRate": round(
+                    sum(rejected.values()) / max(served, 1), 6
+                ),
+                "queueDepthP50": float(qd.get("p50", 0.0)),
+                "queueDepthP95": float(qd.get("p95", 0.0)),
+                "queueDepthP99": float(qd.get("p99", 0.0)),
+                "serving": dv.get("serving", {}),
             },
         )
     finally:
-        srv.close()
+        stop_server(esrv)
+
+    # ---- batching-off c1 baseline (the PR 4 solo-path guard)
+    ratio = event_c1_topn_p50 / max(off_p50, 1e-9)
+    line(
+        "sync_c1_topn_p50_adaptive_vs_off",
+        ratio,
+        "ratio",
+        1.0,
+        extra={
+            "off_p50_ms": round(off_p50, 3),
+            "on_p50_ms": round(event_c1_topn_p50, 3),
+        },
+    )
+    if ratio > 1.10:
+        # the solo-path guard is a GATE, not a datapoint: adaptive
+        # batching adding >10% to c1 p50 is the regression the
+        # acceptance criterion forbids
+        failed = True
+        line("batching_regressed_c1_latency", ratio, "error", ratio)
+
+    # ---- threaded front end c1 baseline (ISSUE 6 solo-latency guard):
+    # c1 p50 on the event loop within 1.1x of the legacy threaded
+    # listener — the concurrency win must not tax the single dashboard
+    event_vs_threaded = event_c1_topn_p50 / max(threaded_p50, 1e-9)
+    line(
+        "serving_c1_topn_p50_event_vs_threaded",
+        event_vs_threaded,
+        "ratio",
+        1.0,
+        extra={
+            "event_p50_ms": round(event_c1_topn_p50, 3),
+            "threaded_p50_ms": round(threaded_p50, 3),
+        },
+    )
+    if event_vs_threaded > 1.10:
+        failed = True
+        line(
+            "serving_regressed_c1_latency_vs_threaded",
+            event_vs_threaded,
+            "error",
+            event_vs_threaded,
+        )
     if failed:
         sys.exit(1)
 

@@ -9,9 +9,11 @@ the peer's base URI so one client serves all peers.
 from __future__ import annotations
 
 import base64
+import http.client
 import json
-import urllib.error
-import urllib.request
+import threading
+import time
+from urllib.parse import urlsplit
 
 from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
@@ -23,17 +25,30 @@ class PeerError(RuntimeError):
     — refused/reset/timeout), so callers classify structurally instead
     of string-matching the message."""
 
-    def __init__(self, uri: str, detail: str, status: int | None = None):
+    def __init__(self, uri: str, detail: str, status: int | None = None,
+                 retry_after: float | None = None):
         super().__init__(f"peer {uri}: {detail}")
         self.uri = uri
         self.status = status
+        # parsed Retry-After seconds on a 429/503 backpressure answer
+        self.retry_after = retry_after
 
     @property
     def retryable(self) -> bool:
         """Safe to retry/fail over: transport failures and server-side
         5xx are transient by classification; a 4xx is a permanent
-        request error that every replica would refuse identically."""
+        request error that every replica would refuse identically.
+        (429 backpressure is 4xx by design: an immediate in-query retry
+        against an admission-full peer is exactly the herd the 429 is
+        shedding — see ``backpressure``.)"""
         return self.status is None or self.status >= 500
+
+    @property
+    def backpressure(self) -> bool:
+        """The peer is alive but shedding load (HTTP 429 from its
+        admission queue): not retryable in-query, and NOT a breaker
+        failure — a healthy-but-busy peer must not be dead-marked."""
+        return self.status == 429
 
 
 class BreakerOpenError(PeerError):
@@ -46,6 +61,77 @@ class BreakerOpenError(PeerError):
         super().__init__(uri, detail, status=None)
 
 
+class _ConnectionPool:
+    """Keep-alive ``http.client`` connections per peer URI.
+
+    The fan-out RPC path used to pay a fresh TCP (+TLS) setup per call
+    (urlopen); under the event-driven front end every peer holds its
+    connections open, so node→node RPCs reuse a small per-peer pool
+    instead.  Idle connections are reaped after ``idle_ttl_s`` —
+    comfortably below the server's keepalive-idle-s default (75s), so
+    the client discards before the server does and stale-socket races
+    stay rare.  Thread-safe; connections are checked out exclusively."""
+
+    __slots__ = ("max_idle_per_peer", "idle_ttl_s", "_lock", "_idle")
+
+    def __init__(self, max_idle_per_peer: int = 8, idle_ttl_s: float = 30.0):
+        self.max_idle_per_peer = max_idle_per_peer
+        self.idle_ttl_s = idle_ttl_s
+        self._lock = threading.Lock()
+        self._idle: dict[str, list[tuple[http.client.HTTPConnection, float]]] = {}
+
+    def acquire(self, uri: str) -> http.client.HTTPConnection | None:
+        """A pooled live-ish connection for the peer, or None (caller
+        dials fresh).  Stale entries are closed on the way past."""
+        now = time.monotonic()
+        with self._lock:
+            bucket = self._idle.get(uri)
+            stale: list[http.client.HTTPConnection] = []
+            conn = None
+            while bucket:
+                cand, last = bucket.pop()
+                if now - last > self.idle_ttl_s:
+                    stale.append(cand)
+                    continue
+                conn = cand
+                break
+        for c in stale:
+            c.close()
+        return conn
+
+    def release(self, uri: str, conn: http.client.HTTPConnection) -> None:
+        overflow = None
+        with self._lock:
+            bucket = self._idle.setdefault(uri, [])
+            if len(bucket) >= self.max_idle_per_peer:
+                overflow = conn
+            else:
+                bucket.append((conn, time.monotonic()))
+        if overflow is not None:
+            overflow.close()
+
+    def evict(self, uri: str) -> int:
+        """Close and drop every idle connection for a peer — called on
+        transport-level failure (the sibling sockets are likely just as
+        dead) and when the peer's circuit breaker opens."""
+        with self._lock:
+            bucket = self._idle.pop(uri, [])
+        for conn, _ in bucket:
+            conn.close()
+        return len(bucket)
+
+    def close(self) -> None:
+        with self._lock:
+            buckets, self._idle = list(self._idle.values()), {}
+        for bucket in buckets:
+            for conn, _ in bucket:
+                conn.close()
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {uri: len(b) for uri, b in self._idle.items() if b}
+
+
 class InternalClient:
     def __init__(self, timeout: float = 30.0, skip_verify: bool = False):
         self.timeout = timeout
@@ -54,6 +140,7 @@ class InternalClient:
         # clusters never import ssl.
         self.skip_verify = skip_verify
         self._ssl_ctx = None
+        self._pool = _ConnectionPool()
 
     def _context(self, uri: str):
         if not (self.skip_verify and uri.startswith("https:")):
@@ -67,6 +154,26 @@ class InternalClient:
             self._ssl_ctx = ctx
         return self._ssl_ctx
 
+    def evict_peer(self, uri: str) -> None:
+        """Drop the peer's pooled connections (resilience layer calls
+        this when the peer's circuit breaker opens — a fast-failed peer
+        must reconnect from scratch once it recovers)."""
+        self._pool.evict(uri)
+
+    def close(self) -> None:
+        self._pool.close()
+
+    def _dial(self, uri: str, timeout: float) -> http.client.HTTPConnection:
+        parts = urlsplit(uri)
+        host = parts.hostname or ""
+        if parts.scheme == "https":
+            import ssl  # noqa: F401 — context may come from _context()
+
+            return http.client.HTTPSConnection(
+                host, parts.port, timeout=timeout, context=self._context(uri)
+            )
+        return http.client.HTTPConnection(host, parts.port, timeout=timeout)
+
     def _request(
         self,
         method: str,
@@ -79,9 +186,9 @@ class InternalClient:
         # deferred import: resilience imports this module at load time
         from pilosa_tpu.parallel import resilience
 
-        req = urllib.request.Request(uri + path, data=body, method=method)
+        headers: dict[str, str] = {}
         if body is not None:
-            req.add_header("Content-Type", content_type)
+            headers["Content-Type"] = content_type
         # per-query deadline budget: cap the socket timeout at the
         # remaining budget and forward it (decremented by construction —
         # the header always carries what is LEFT at send time) so the
@@ -92,30 +199,70 @@ class InternalClient:
             if rem <= 0:
                 raise deadline.exceeded(f"RPC to {uri}{path}")
             timeout = min(self.timeout if timeout is None else timeout, rem)
-            req.add_header(resilience.DEADLINE_HEADER, str(int(rem * 1e3)))
+            headers[resilience.DEADLINE_HEADER] = str(int(rem * 1e3))
         # trace propagation (Inject): the receiving node's spans join the
         # caller's trace and parent onto the span active on this thread
         ctx = GLOBAL_TRACER.current_context()
         if ctx is not None:
-            req.add_header(tracing.TRACE_HEADER, ctx[0])
+            headers[tracing.TRACE_HEADER] = ctx[0]
             if ctx[1]:
-                req.add_header(tracing.PARENT_HEADER, ctx[1])
-        try:
-            with urllib.request.urlopen(
-                req,
-                timeout=self.timeout if timeout is None else timeout,
-                context=self._context(uri),
-            ) as resp:
+                headers[tracing.PARENT_HEADER] = ctx[1]
+        t = self.timeout if timeout is None else timeout
+        # one transparent redial on a stale pooled socket, and only for
+        # GETs: a POSTed write re-sent after an ambiguous failure could
+        # be a duplicated write — non-idempotent requests surface the
+        # PeerError and let the resilience layer decide
+        attempts = 2 if method == "GET" else 1
+        for attempt in range(attempts):
+            conn = self._pool.acquire(uri)
+            reused = conn is not None
+            if conn is None:
+                conn = self._dial(uri, t)
+            else:
+                conn.timeout = t
+                if conn.sock is not None:
+                    conn.sock.settimeout(t)
+            try:
+                conn.request(method, path, body=body, headers=headers)
+                resp = conn.getresponse()
                 data = resp.read()
-                prof = tracing.current_profile()
-                if prof is not None:
-                    prof.note_rpc_bytes(len(data))
-                return data
-        except urllib.error.HTTPError as e:
-            detail = e.read().decode(errors="replace")
-            raise PeerError(uri, f"HTTP {e.code}: {detail}", status=e.code) from e
-        except OSError as e:
-            raise PeerError(uri, str(e)) from e
+                status = resp.status
+                will_close = resp.will_close
+            except (OSError, http.client.HTTPException) as e:
+                conn.close()
+                if reused and attempt + 1 < attempts:
+                    # stale keep-alive socket (server reaped it between
+                    # calls): the sibling pool entries are suspect too
+                    self._pool.evict(uri)
+                    continue
+                if not reused:
+                    # fresh-dial failure: the peer itself is unhealthy —
+                    # drop any idle siblings so recovery reconnects clean
+                    self._pool.evict(uri)
+                raise PeerError(uri, str(e)) from e
+            if will_close:
+                conn.close()
+            else:
+                self._pool.release(uri, conn)
+            if status >= 400:
+                retry_after = None
+                raw_ra = resp.getheader("Retry-After")
+                if raw_ra is not None:
+                    try:
+                        retry_after = float(raw_ra)
+                    except ValueError:
+                        retry_after = None
+                raise PeerError(
+                    uri,
+                    f"HTTP {status}: {data.decode(errors='replace')}",
+                    status=status,
+                    retry_after=retry_after,
+                )
+            prof = tracing.current_profile()
+            if prof is not None:
+                prof.note_rpc_bytes(len(data))
+            return data
+        raise AssertionError("unreachable: request loop exits via return/raise")
 
     def _json(
         self,

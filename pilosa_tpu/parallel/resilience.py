@@ -424,6 +424,18 @@ class ResilientClient:
         return getattr(self._inner, name)
 
     # ----------------------------------------------------- machinery
+    def _note_failure(self, uri, breaker) -> None:
+        """Record a peer failure and, when it OPENS the breaker, evict
+        the transport's pooled keep-alive connections for that peer —
+        a fast-failed peer's sockets are dead weight, and its recovery
+        must reconnect from scratch (docs/serving.md)."""
+        state = breaker.record_failure()
+        self.breakers.note(uri, state)
+        if state == BREAKER_OPEN:
+            evict = getattr(self._inner, "evict_peer", None)
+            if evict is not None:
+                evict(uri)
+
     def _single_shot(self, name, uri, *args, **kwargs):
         """One ungated, unretried attempt (writes and /status probes):
         the breaker observes PeerError outcomes; a locally-died attempt
@@ -434,9 +446,19 @@ class ResilientClient:
         breaker = self.breakers.get(uri)
         try:
             out = getattr(self._inner, name)(uri, *args, **kwargs)
-        except PeerError:
-            if breaker is not None:
-                self.breakers.note(uri, breaker.record_failure())
+        except PeerError as e:
+            if e.backpressure:
+                # 429 from the peer's admission queue: the peer is alive
+                # and shedding load — neither a breaker failure (it
+                # would dead-mark a healthy-but-busy node) nor a success
+                if self._stats is not None:
+                    self._stats.count(
+                        "rpc_backpressure", tags={"method": name}
+                    )
+                if breaker is not None:
+                    breaker.release_trial()
+            elif breaker is not None:
+                self._note_failure(uri, breaker)
             raise
         except BaseException:
             if breaker is not None:
@@ -462,8 +484,22 @@ class ResilientClient:
             try:
                 out = fn(uri, *args, **kwargs)
             except PeerError as e:
+                if e.backpressure:
+                    # non-retryable-with-backoff: an in-query retry
+                    # against an admission-full peer is the herd its
+                    # 429 is shedding. Surface it (the caller's
+                    # failover can pick another replica, or the client
+                    # honors e.retry_after) without a breaker failure —
+                    # the peer is alive, just busy.
+                    if self._stats is not None:
+                        self._stats.count(
+                            "rpc_backpressure", tags={"method": name}
+                        )
+                    if breaker is not None:
+                        breaker.release_trial()
+                    raise
                 if breaker is not None:
-                    self.breakers.note(uri, breaker.record_failure())
+                    self._note_failure(uri, breaker)
                 if not e.retryable or attempt + 1 >= attempts:
                     raise
                 delay = self.policy.backoff(attempt)

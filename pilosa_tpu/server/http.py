@@ -305,10 +305,18 @@ class Handler(BaseHTTPRequestHandler):
         (the remaining budget of an upstream hop, or a client opting
         into a tighter bound) wins over the server's configured
         ``query-timeout-ms`` default — plus the ``?allow-partial=true``
-        opt-in for labeled partial results under replica loss."""
-        deadline = resilience.deadline_from_header(
-            self.headers.get(resilience.DEADLINE_HEADER)
-        )
+        opt-in for labeled partial results under replica loss.
+
+        On the event-driven front end the deadline starts ticking at
+        ADMISSION, not here: the accept loop installs the Deadline it
+        created when the request head arrived (docs/serving.md), so time
+        spent queued behind other work counts against the budget — a
+        query must never get a fresh clock just because it waited."""
+        deadline = getattr(self, "admission_deadline", None)
+        if deadline is None:
+            deadline = resilience.deadline_from_header(
+                self.headers.get(resilience.DEADLINE_HEADER)
+            )
         if deadline is None and self.server.query_timeout_ms > 0:
             deadline = resilience.Deadline(self.server.query_timeout_ms / 1e3)
         allow_partial = self.query_params.get("allow-partial", [""])[
@@ -504,6 +512,9 @@ class Handler(BaseHTTPRequestHandler):
         # cross-query wave coalescing: waves, occupancy, dedup hits
         # (docs/query-batching.md)
         out["queryBatching"] = self.api.scheduler.snapshot()
+        # serving front end: connection counts, admission queue state,
+        # per-class concurrency limits (docs/serving.md)
+        out["serving"] = self.server.serving_snapshot()
         self._json(out)
 
     def h_debug_traces(self) -> None:
@@ -634,43 +645,15 @@ class Handler(BaseHTTPRequestHandler):
         self._json(self.api.shard_nodes(index, int(shard)))
 
 
-class HTTPServer(ThreadingHTTPServer):
-    """HTTP front end bound to an API façade.
+class _ServerCore:
+    """Front-end-independent server state: the API binding, the router
+    hooks the cluster layer swaps in, and the /internal extra-route
+    table.  Shared by the event-driven listener (server/eventloop.py —
+    the default) and the legacy thread-per-request listener below, so
+    the cluster layer and the runtime Server wire one attribute surface
+    regardless of serving mode."""
 
-    ``query_router`` / ``import_router`` default to local execution; the
-    cluster layer swaps them for scatter-gather versions. ``handle_extra``
-    lets the cluster layer mount /internal/* data-plane routes.
-    """
-
-    daemon_threads = True
-    # the socketserver default backlog (5) resets connections under a
-    # burst of concurrent clients — exactly the many-sync-users shape
-    # the wave scheduler serves; size it for a connect storm instead
-    request_queue_size = 128
-
-    def handle_error(self, request, client_address):
-        import sys
-
-        exc = sys.exc_info()[1]
-        if isinstance(
-            exc,
-            (ConnectionResetError, BrokenPipeError, TimeoutError,
-             ConnectionAbortedError),
-        ):
-            return  # routine client teardown, not a server fault
-        if self.ssl_context is not None:
-            import ssl
-
-            if isinstance(exc, ssl.SSLError):
-                # failed/aborted client handshake (plaintext speaker on
-                # the TLS port, cert rejected by a strict client): the
-                # client's problem, logged by the client — a per-event
-                # server traceback would spray the log under portscans
-                return
-        super().handle_error(request, client_address)
-
-    def __init__(self, addr: tuple[str, int], api, stats: StatsClient | None = None):
-        super().__init__(addr, Handler)
+    def _init_core(self, api, stats: StatsClient | None) -> None:
         self.ssl_context = None  # set by Server.open() for TLS serving
         self.api = api
         self.stats = stats or StatsClient()
@@ -717,6 +700,57 @@ class HTTPServer(ThreadingHTTPServer):
         else:
             self.api.import_bits(index, field, payload)
 
+    def handle_extra(self, handler: Handler, method: str, path: str) -> bool:
+        for (m, pattern), fn in self.extra_routes.items():
+            if m == method:
+                match = pattern.match(path)
+                if match:
+                    fn(handler, *match.groups())
+                    return True
+        return False
+
+    def serving_snapshot(self) -> dict:
+        """Serving-front-end state for /debug/vars (docs/serving.md);
+        the event-driven listener overrides with live admission state."""
+        return {"mode": "threaded"}
+
+
+class ThreadedHTTPServer(_ServerCore, ThreadingHTTPServer):
+    """Legacy thread-per-request front end (config serving-mode =
+    "threaded"): one OS thread parks per in-flight request, so cheap
+    queries regress under fan-in (BENCH_SWEEP_r06_cpu: c32 = 0.88x c1)
+    and connect storms exhaust the accept backlog.  Kept as a rollback
+    path and as the latency baseline the event-driven front end is
+    benchmarked against (bench_all config8); it has no admission
+    control — do not put it in front of high-fan-in traffic."""
+
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(
+            exc,
+            (ConnectionResetError, BrokenPipeError, TimeoutError,
+             ConnectionAbortedError),
+        ):
+            return  # routine client teardown, not a server fault
+        if self.ssl_context is not None:
+            import ssl
+
+            if isinstance(exc, ssl.SSLError):
+                # failed/aborted client handshake (plaintext speaker on
+                # the TLS port, cert rejected by a strict client): the
+                # client's problem, logged by the client — a per-event
+                # server traceback would spray the log under portscans
+                return
+        super().handle_error(request, client_address)
+
+    def __init__(self, addr: tuple[str, int], api, stats: StatsClient | None = None):
+        super().__init__(addr, Handler)
+        self._init_core(api, stats)
+
     def get_request(self):
         """Accept, then wrap per-connection for TLS with the handshake
         DEFERRED (do_handshake_on_connect=False): get_request runs on the
@@ -730,16 +764,16 @@ class HTTPServer(ThreadingHTTPServer):
             )
         return sock, addr
 
-    def handle_extra(self, handler: Handler, method: str, path: str) -> bool:
-        for (m, pattern), fn in self.extra_routes.items():
-            if m == method:
-                match = pattern.match(path)
-                if match:
-                    fn(handler, *match.groups())
-                    return True
-        return False
-
     def serve_background(self) -> threading.Thread:
         t = threading.Thread(target=self.serve_forever, daemon=True)
         t.start()
         return t
+
+
+# the default front end: the asyncio accept/read/write loop with
+# keep-alive multiplexing and bounded admission (docs/serving.md).
+# Imported at the bottom so eventloop.py can subclass Handler above;
+# the name HTTPServer stays here because the runtime Server, the
+# cluster tests, and the package __init__ all import it from this
+# module.
+from pilosa_tpu.server.eventloop import EventHTTPServer as HTTPServer  # noqa: E402

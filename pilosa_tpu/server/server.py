@@ -14,7 +14,7 @@ import threading
 
 from pilosa_tpu.core import Holder
 from pilosa_tpu.server.api import API
-from pilosa_tpu.server.http import HTTPServer
+from pilosa_tpu.server.http import HTTPServer, ThreadedHTTPServer
 from pilosa_tpu.utils.config import Config
 
 # process-wide device-backend probe verdict (backends are process-global)
@@ -97,9 +97,25 @@ class Server:
         connection-refused — concurrent cold starts then stack 30s
         timeouts on each other."""
         self.holder.open()
-        self.http = HTTPServer(
+        # event-driven front end by default (docs/serving.md); the
+        # legacy thread-per-request listener stays as a rollback knob
+        # and as the latency baseline the bench sweep compares against
+        server_cls = (
+            ThreadedHTTPServer
+            if self.config.serving_mode == "threaded"
+            else HTTPServer
+        )
+        self.http = server_cls(
             (self.config.host, self.config.port), self.api, stats=self.stats
         )
+        if server_cls is HTTPServer:
+            # admission/backpressure knobs (docs/serving.md): these
+            # replace the old fixed request_queue_size accept backlog
+            self.http.max_connections = self.config.max_connections
+            self.http.admission_queue_depth = self.config.admission_queue_depth
+            self.http.keepalive_idle_s = self.config.keepalive_idle_s
+            self.http.request_read_timeout_s = self.config.request_read_timeout_s
+            self.http.worker_threads = self.config.http_worker_threads
         if self.config.tls_certificate:
             # serve HTTPS (reference: tls.certificate/tls.key). The context
             # is handed to the listener, which wraps each accepted

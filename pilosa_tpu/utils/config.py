@@ -29,6 +29,33 @@ class Config:
     anti_entropy_interval: float = 600.0  # seconds; 0 disables
     heartbeat_interval: float = 2.0  # peer liveness probe period
     diagnostics_interval: float = 3600.0  # snapshot period; 0 disables
+    # serving front end (docs/serving.md): "event" = the asyncio
+    # accept/read/write loop with keep-alive multiplexing and bounded
+    # admission (the default); "threaded" = the legacy thread-per-
+    # request listener (rollback / latency-baseline only — no admission
+    # control)
+    serving_mode: str = "event"
+    # open-connection cap for the event front end (0 = unlimited);
+    # connections past it get 503 + Retry-After at accept
+    max_connections: int = 0
+    # bounded admission wait queue PER CLASS (query/write/control); a
+    # request arriving with the class queue full gets 429 + Retry-After
+    # instead of parking (0 = unbounded — not recommended)
+    admission_queue_depth: int = 256
+    # seconds an idle keep-alive connection is held before the server
+    # closes it (0 = never reap)
+    keepalive_idle_s: float = 75.0
+    # seconds a client gets to deliver a request head or body once it
+    # starts one — the slowloris cut (0 disables; also the TLS
+    # handshake timeout on the event front end)
+    request_read_timeout_s: float = 10.0
+    # query-class worker threads for the event front end (execution
+    # stays on a bounded pool; the event loop only owns I/O and
+    # admission). 0 = auto: max(32, min(64, 4x cores)) — sized to wave
+    # occupancy, not cores: query workers park as wave followers or in
+    # GIL-released device calls. The write class gets half, control a
+    # quarter (min 4).
+    http_worker_threads: int = 0
     # limits
     max_writes_per_request: int = 5000
     long_query_time: float = 0.0  # seconds; log slower queries (0 = off)
@@ -204,6 +231,12 @@ def config_template() -> str:
         "anti-entropy-interval = 600.0\n"
         "heartbeat-interval = 2.0\n"
         "diagnostics-interval = 3600.0\n"
+        'serving-mode = "event"\n'
+        "max-connections = 0\n"
+        "admission-queue-depth = 256\n"
+        "keepalive-idle-s = 75.0\n"
+        "request-read-timeout-s = 10.0\n"
+        "http-worker-threads = 0\n"
         "max-writes-per-request = 5000\n"
         "long-query-time = 0.0\n"
         'log-path = ""\n'

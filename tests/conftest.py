@@ -52,6 +52,18 @@ def pytest_configure(config):
         "runs in tier-1 — the marker exists so `pytest -m faults` scopes "
         "to it)",
     )
+    config.addinivalue_line(
+        "markers",
+        "serving: event-driven front-end suite — keep-alive multiplexing, "
+        "admission control/backpressure, slow/abusive-client defenses, "
+        "connection pooling (tests/test_serving.py; runs in tier-1 — the "
+        "marker exists so `pytest -m serving` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
+        "slow: long/large-scale scenarios excluded from the tier-1 run "
+        "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
+    )
 
 
 @pytest.fixture

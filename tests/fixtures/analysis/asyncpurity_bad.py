@@ -1,0 +1,28 @@
+"""Seeded violations for the ``asyncpurity`` rule: blocking calls
+inside event-loop coroutines — each one stalls every connection the
+loop serves."""
+
+import socket
+import threading
+import time
+
+
+async def sleepy_coroutine():
+    time.sleep(0.1)  # <- blocks the loop: must flag
+
+
+async def file_io_coroutine(path: str) -> bytes:
+    with open(path, "rb") as f:  # <- blocking file I/O: must flag
+        return f.read()
+
+
+async def socket_coroutine(sock: socket.socket):
+    peer = socket.create_connection(("127.0.0.1", 1))  # <- must flag
+    conn, _addr = sock.accept()  # <- blocking socket method: must flag
+    peer.close()
+    return conn
+
+
+async def thread_spawn_coroutine():
+    t = threading.Thread(target=print)  # <- thread spawn: must flag
+    t.start()

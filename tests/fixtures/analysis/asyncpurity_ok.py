@@ -1,0 +1,31 @@
+"""Clean twin for the ``asyncpurity`` rule: coroutines that stay pure —
+async primitives for waiting, ``run_in_executor`` as the sanctioned
+hand-off to blocking code, and blocking calls confined to sync
+functions (which execute on worker threads, not the loop)."""
+
+import asyncio
+import time
+
+
+def blocking_worker(path: str) -> bytes:
+    # sync helper: runs on the worker pool, where blocking is the point
+    time.sleep(0.001)
+    with open(path, "rb") as f:
+        return f.read()
+
+
+async def pure_coroutine(path: str) -> bytes:
+    await asyncio.sleep(0.001)  # async wait: fine
+    loop = asyncio.get_running_loop()
+    # the sanctioned hand-off: the callable is PASSED, never called here
+    return await loop.run_in_executor(None, blocking_worker, path)
+
+
+async def pure_with_nested_def(path: str) -> bytes:
+    def handoff() -> bytes:
+        # nested sync def bodies are hand-off targets — blocking allowed
+        time.sleep(0.001)
+        return b"done"
+
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(None, handoff)

@@ -75,6 +75,7 @@ def test_rule_registry_complete():
         "mutable-default",
         "wall-clock",
         "resilience",
+        "asyncpurity",
     ):
         assert name in out, f"rule {name} missing from registry"
 
@@ -90,6 +91,7 @@ def test_rule_registry_complete():
             ["bare-except", "broad-except", "mutable-default", "wall-clock"],
         ),
         ("resilience_bad.py", ["resilience"]),
+        ("asyncpurity_bad.py", ["asyncpurity"]),
     ],
 )
 def test_seeded_fixture_fails(fixture, rules):
@@ -101,7 +103,13 @@ def test_seeded_fixture_fails(fixture, rules):
 
 @pytest.mark.parametrize(
     "fixture",
-    ["readback_ok.py", "locks_ok.py", "banned_ok.py", "resilience_ok.py"],
+    [
+        "readback_ok.py",
+        "locks_ok.py",
+        "banned_ok.py",
+        "resilience_ok.py",
+        "asyncpurity_ok.py",
+    ],
 )
 def test_clean_fixture_passes(fixture):
     rc, out = run_analyzer(str(FIXTURES / fixture))
@@ -373,6 +381,41 @@ def test_resilience_unflagged_write_leg_fails(tree_copy):
     rc, out = check_tree(tree_copy)
     assert rc != 0
     assert "[resilience]" in out and "write=True" in out
+
+
+def test_asyncpurity_sleep_in_coroutine_fails(tree_copy):
+    # a time.sleep smuggled into the event loop's connection coroutine:
+    # every connection the process serves would stall behind it — the
+    # exact failure mode the event-driven front end replaced
+    # thread-per-request to avoid (docs/serving.md)
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "eventloop.py",
+        "head = await self._read_head(reader, conn)\n",
+        "time.sleep(0)\n                head = await self._read_head(reader, conn)\n",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[asyncpurity]" in out and "time.sleep" in out
+
+
+def test_asyncpurity_thread_spawn_in_coroutine_fails(tree_copy):
+    # per-request thread spawns from the loop would silently rebuild the
+    # thread-per-request model the bounded worker pool replaced
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "eventloop.py",
+        "payload, close = await loop.run_in_executor(\n"
+        "                self._pool, self._run_request, raw, writer, deadline,\n"
+        "                direct_ok,\n"
+        "            )",
+        "_t = threading.Thread(\n"
+        "                target=self._run_request, args=(raw, writer, deadline)\n"
+        "            )\n"
+        "            _t.start()\n"
+        "            payload, close = b\"\", True",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[asyncpurity]" in out and "threading.Thread" in out
 
 
 # ----------------------------------------------------------------- fixes

@@ -176,8 +176,17 @@ def test_statsd_emission(tmp_path):
     s.open()
     try:
         call(s, "GET", "/status")
-        msg = sink.recv(4096).decode()
-        assert msg.startswith("pilosa_tpu.http_requests:1|c"), msg
+        # the event front end emits connection/admission metrics before
+        # the route counter — drain datagrams until it shows up instead
+        # of assuming arrival order
+        msgs = []
+        for _ in range(10):
+            msgs.append(sink.recv(4096).decode())
+            if any(m.startswith("pilosa_tpu.http_requests:1|c") for m in msgs):
+                break
+        assert any(
+            m.startswith("pilosa_tpu.http_requests:1|c") for m in msgs
+        ), msgs
         # the registry still feeds /metrics
         text = call(s, "GET", "/metrics", raw=True).decode()
         assert "pilosa_tpu_http_requests" in text

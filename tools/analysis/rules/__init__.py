@@ -2,6 +2,7 @@
 the engine (tools.analysis.engine.get_rules)."""
 
 from tools.analysis.rules import (  # noqa: F401
+    asyncpurity,
     banned,
     configdrift,
     locks,

@@ -1,0 +1,108 @@
+"""Event-loop purity.
+
+The event-driven front end's contract (docs/serving.md): the asyncio
+loop owns ONLY I/O, admission, and wave hand-off — one blocking call in
+a coroutine stalls every connection the process serves, which is the
+whole failure mode the front end replaced thread-per-request to avoid.
+Enforced structurally: inside any ``async def`` body (NOT descending
+into nested function definitions — a nested ``def`` is a hand-off
+target that executes elsewhere), these calls are banned:
+
+- ``time.sleep``            → ``await asyncio.sleep(...)``
+- ``open(...)``             → blocking file I/O; hand off to the pool
+- raw socket work (``socket.socket``/``create_connection``/
+  ``create_server``, ``.accept``/``.recv``/``.recv_into``/
+  ``.sendall``) → asyncio streams own the sockets
+- ``urllib.request.urlopen`` → blocking HTTP stalls the loop
+- ``subprocess.run``/``Popen``/``check_output``/``check_call``
+- thread spawns (``threading.Thread``) → the bounded worker pool via
+  ``loop.run_in_executor`` is the one sanctioned hand-off point, and it
+  is exempt by construction (the callable is passed, not called)
+
+Suppression: ``# pilosa: allow(asyncpurity)`` on the flagged line, for
+the rare case where a call is provably non-blocking.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analysis.engine import Project, Violation, call_name, rule
+
+_BANNED_DOTTED = {
+    "time.sleep": "blocks the loop; use `await asyncio.sleep(...)`",
+    "socket.socket": "raw sockets block; asyncio streams own the I/O",
+    "socket.create_connection": "raw sockets block; asyncio streams own the I/O",
+    "socket.create_server": "bind before the loop starts, or use asyncio.start_server",
+    "urllib.request.urlopen": "blocking HTTP stalls every connection",
+    "subprocess.run": "process waits block the loop; hand off to the pool",
+    "subprocess.Popen": "process waits block the loop; hand off to the pool",
+    "subprocess.check_output": "process waits block the loop; hand off to the pool",
+    "subprocess.check_call": "process waits block the loop; hand off to the pool",
+    "threading.Thread": "per-event thread spawns defeat the bounded "
+    "worker pool; use loop.run_in_executor",
+}
+# bare names (from-imports of the same primitives)
+_BANNED_BARE = {
+    "open": "blocking file I/O stalls every connection; hand off to the pool",
+    "urlopen": "blocking HTTP stalls every connection",
+    "Thread": "per-event thread spawns defeat the bounded worker pool; "
+    "use loop.run_in_executor",
+}
+# blocking socket METHOD calls on any receiver
+_SOCKET_METHODS = {"accept", "recv", "recv_into", "sendall"}
+
+
+def _own_calls(fn: ast.AsyncFunctionDef) -> Iterator[ast.Call]:
+    """Call nodes in the coroutine's own body, not descending into
+    nested function definitions (nested async defs are visited as
+    coroutines in their own right by the outer walk)."""
+    stack: list[ast.AST] = list(fn.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+@rule(
+    "asyncpurity",
+    "no blocking I/O, sleeps, or thread spawns inside event-loop coroutines",
+)
+def check_asyncpurity(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for c in _own_calls(fn):
+                name = call_name(c.func)
+                why = None
+                if name in _BANNED_DOTTED:
+                    why = _BANNED_DOTTED[name]
+                elif name in _BANNED_BARE:
+                    why = _BANNED_BARE[name]
+                else:
+                    tail = name.rsplit(".", 1)[-1] if "." in name else ""
+                    if tail in _SOCKET_METHODS:
+                        why = (
+                            "blocking socket method in a coroutine; "
+                            "asyncio streams own the I/O"
+                        )
+                if why is not None:
+                    out.append(
+                        Violation(
+                            "asyncpurity",
+                            f.rel,
+                            c.lineno,
+                            f"blocking call {name}() inside event-loop "
+                            f"coroutine {fn.name}() — {why} (sanctioned "
+                            "hand-off: loop.run_in_executor)",
+                        )
+                    )
+    return out
