@@ -579,12 +579,25 @@ class StackCache:
 
 # ------------------------------------------------------------------ plans
 class _Planner:
-    """Builds (closure, leaf inputs, structure key) for one call tree."""
+    """Builds (closure, leaf inputs, structure key) for one call tree.
 
-    def __init__(self, idx: Index, shards: list[int], stacks: StackCache):
+    ``block_shape`` is the [S, W] plane shape the closures trace against:
+    the global (len(shards), WORDS_PER_SHARD) for single-program jit, or
+    the per-device block when the closure will run inside a shard_map
+    program (zero leaves must be block-shaped there — a global-shaped
+    zeros would shape-mismatch every sharded operand)."""
+
+    def __init__(
+        self,
+        idx: Index,
+        shards: list[int],
+        stacks: StackCache,
+        block_shape: tuple[int, int] | None = None,
+    ):
         self.idx = idx
         self.shards = shards
         self.stacks = stacks
+        self.block_shape = block_shape or (len(shards), WORDS_PER_SHARD)
         self._builders: list[Callable[[], Any]] = []  # device-input thunks
         self.scalars: list = []  # traced row-id/slot inputs (int | thunk)
         self._array_keys: dict[tuple, int] = {}
@@ -681,7 +694,7 @@ class _Planner:
             )
         if ef is None:
             return (lambda arrays, scalars: jnp.zeros(
-                (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                self.block_shape, jnp.uint32
             )), "exists(empty)"
         return self._matrix_leaf(ef, VIEW_STANDARD, 0)
 
@@ -713,7 +726,7 @@ class _Planner:
                 if name == "Intersect":
                     raise PlanError("Intersect() needs at least one child")
                 zero = lambda arrays, scalars: jnp.zeros(
-                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                    self.block_shape, jnp.uint32
                 )
                 return zero, f"{name}()"
             fns = [s[0] for s in subs]
@@ -782,7 +795,7 @@ class _Planner:
             bounds = field.time_bounds()
             if bounds is None:
                 zero = lambda arrays, scalars: jnp.zeros(
-                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                    self.block_shape, jnp.uint32
                 )
                 return zero, "time(empty)"
             ts_from = ts_from if ts_from is not None else bounds[0]
@@ -797,7 +810,7 @@ class _Planner:
             subs = [self._matrix_leaf(field, v, row_id) for v in view_names]
             if not subs:
                 zero = lambda arrays, scalars: jnp.zeros(
-                    (len(self.shards), WORDS_PER_SHARD), jnp.uint32
+                    self.block_shape, jnp.uint32
                 )
                 return zero, "time(empty)"
             fns = [s[0] for s in subs]
@@ -886,6 +899,16 @@ class QueryCompiler:
         from pilosa_tpu.executor.hostpath import HostEngine
 
         self.host = HostEngine()
+        # the MESH compilation layer: explicit shard_map programs with
+        # psum reduction trees over the (shards × words) mesh — the
+        # router's third path (docs/spmd.md). Only attached for a real
+        # multi-device mesh; a 1-device mesh compiles to the identical
+        # program with placement overhead on top.
+        self.mesh_engine = None
+        if mesh_ctx is not None and getattr(mesh_ctx, "n_devices", 1) > 1:
+            from pilosa_tpu.parallel.mesh import MeshQueryEngine
+
+            self.mesh_engine = MeshQueryEngine(mesh_ctx.mesh)
 
     def device_scalars(self, values: list[int]):
         """Device-resident int32 operand vector, cached by VALUE.
@@ -1033,4 +1056,101 @@ class QueryCompiler:
         return self.call_program(
             key, prog, arrays, self.device_scalars(planner.scalar_values())
         )
+
+    # ------------------------------------------------------ mesh programs
+    # The explicit-SPMD (shard_map) compile path. Planner closures are the
+    # SAME ones the single-program path uses — planned against the mesh's
+    # per-device block shape so zero leaves trace block-shaped — and the
+    # MeshQueryEngine wraps them in shard_map with the psum reduction
+    # trees. Program/AOT caching rides the same caches as every other
+    # program ("mesh" + spec mode in the key).
+
+    def mesh_mode(self, n_shards: int) -> str | None:
+        """The mesh placement mode serving this shard count, or None when
+        no mesh is attached / the shapes only replicate (no mesh program)."""
+        if self.mesh_engine is None:
+            return None
+        return self.mesh_engine.spec_mode(n_shards, WORDS_PER_SHARD)
+
+    def mesh_plan(self, idx: Index, call: Call, shards: list[int], mode: str):
+        """(planner, run, skey) with block-shaped zero leaves for ``mode``."""
+        planner = _Planner(
+            idx,
+            shards,
+            self.stacks,
+            block_shape=self.mesh_engine.block_shape(
+                len(shards), WORDS_PER_SHARD, mode
+            ),
+        )
+        run, skey = planner.plan(call)
+        return planner, run, skey
+
+    def _mesh_dispatch(self, name: str, key: tuple, prog, *args):
+        """Issue one mesh program: spanned per program (the
+        ``mesh.dispatch`` trace surface) and counted for /debug/vars."""
+        from pilosa_tpu.utils.tracing import GLOBAL_TRACER
+
+        eng = self.mesh_engine
+        eng.note_call(name)
+        with GLOBAL_TRACER.span(
+            "mesh.dispatch", program=name, devices=eng.n_devices
+        ):
+            return self.call_program(key, prog, *args)
+
+    def mesh_bitmap_device(self, idx: Index, call: Call, shards: list[int]):
+        """Bitmap call tree as ONE shard_map program → sharded
+        uint32[S, W] (elementwise per device block; no collectives)."""
+        mode = self.mesh_mode(len(shards))
+        planner, run, skey = self.mesh_plan(idx, call, shards, mode)
+        key = (idx.name, len(shards), skey, "mesh", mode, "words")
+        prog = self.program(
+            key, lambda: self.mesh_engine.bitmap_tree(run, mode)
+        )
+        arrays = planner.materialize()
+        return self._mesh_dispatch(
+            "bitmap",
+            key,
+            prog,
+            arrays,
+            self.device_scalars(planner.scalar_values()),
+        )
+
+    def mesh_bitmap_words(self, idx: Index, call: Call, shards: list[int]) -> np.ndarray:
+        """Synchronous mesh bitmap: the gather of the sharded result IS a
+        collective readback — spanned as ``mesh.collective`` so the query
+        trace shows where the cross-chip transfer happened."""
+        from pilosa_tpu.utils.tracing import GLOBAL_TRACER
+
+        dev = self.mesh_bitmap_device(idx, call, shards)
+        with GLOBAL_TRACER.span(
+            "mesh.collective", program="bitmap",
+            devices=self.mesh_engine.n_devices,
+        ):
+            return np.asarray(dev)
+
+    def mesh_count_async(self, idx: Index, call: Call, shards: list[int]):
+        """Count as one shard_map program → replicated int64 (not
+        synced); rides the same readback wave as every other pending."""
+        mode = self.mesh_mode(len(shards))
+        planner, run, skey = self.mesh_plan(idx, call, shards, mode)
+        key = (idx.name, len(shards), skey, "mesh", mode, "count")
+        prog = self.program(
+            key, lambda: self.mesh_engine.count_tree(run, mode)
+        )
+        arrays = planner.materialize()
+        return self._mesh_dispatch(
+            "count",
+            key,
+            prog,
+            arrays,
+            self.device_scalars(planner.scalar_values()),
+        )
+
+    def mesh_snapshot(self) -> dict:
+        """/debug/vars ``meshExecution`` section."""
+        if self.mesh_engine is None:
+            return {"attached": False}
+        out = {"attached": True}
+        out.update(self.mesh_engine.snapshot())
+        return out
 

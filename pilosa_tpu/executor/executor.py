@@ -137,13 +137,22 @@ class _Pending:
     and the per-query resolve so one query's finish() failure cannot
     strand its wave-mates."""
 
-    __slots__ = ("arrays", "finish", "value", "fetched")
+    __slots__ = ("arrays", "finish", "value", "fetched", "route")
 
-    def __init__(self, arrays: list, finish: "Callable[[list], Any]") -> None:
+    def __init__(
+        self,
+        arrays: list,
+        finish: "Callable[[list], Any]",
+        route: str = "device",
+    ) -> None:
         self.arrays = list(arrays)
         self.finish = finish
         self.value = None
         self.fetched: list | None = None
+        # which engine produced the arrays ("device" | "mesh") — the
+        # readback wave attributes its measured latency to the matching
+        # router EWMA so the two paths calibrate independently
+        self.route = route
 
     def resolve_now(self) -> Any:
         self.value = self.finish([np.asarray(a) for a in self.arrays])
@@ -244,6 +253,15 @@ class Executor:
             if router is not None
             else QueryRouter(mode=route_mode, stats=stats)
         )
+        # the router's mesh path exists only while a multi-device mesh
+        # is attached; a rebuild WITHOUT one (failed attach, CPU pin)
+        # must also reset it or a persistent router would keep routing
+        # to an engine the new executor doesn't have
+        self.router.mesh_devices = (
+            self.compiler.mesh_engine.n_devices
+            if self.compiler.mesh_engine is not None
+            else 1
+        )
 
     # ------------------------------------------------------------ entry
     def execute(
@@ -302,14 +320,19 @@ class Executor:
                     self._execute_call(idx, c, shards, lazy=True, route=route)
                 )
             elapsed = time.perf_counter() - t0
-            if route in ("host", "device"):
+            if route in ("host", "device", "mesh"):
                 self.router.record(route)
                 if work > 0:
                     # feed the calibration: host samples refine host
-                    # throughput/overhead, device samples the dispatch cost
+                    # throughput/overhead, device/mesh samples their
+                    # respective dispatch costs
                     self.router.observe(route, work, elapsed)
                 if self.stats is not None:
                     self.stats.count("queries_routed", tags={"path": route})
+                if route == "mesh" and prof is not None:
+                    # ?profile=true names the mesh route per call (the
+                    # entry's route tag) AND the mesh geometry once
+                    prof.mesh = self.compiler.mesh_snapshot()
             if self.stats is not None:
                 self.stats.timing(
                     "executor_call_seconds", elapsed, tags={"call": c.name}
@@ -334,7 +357,11 @@ class Executor:
         t0 = time.perf_counter()
         fetch_wave(pending)
         elapsed = time.perf_counter() - t0
-        self.router.observe_readback(elapsed)
+        # attribute the wave's measured latency to every path that rode
+        # it — mesh and device pendings calibrate separate EWMAs, and a
+        # shared wave's cost is what each path's queries actually paid
+        for path in {p.route for p in pending}:
+            self.router.observe_readback(elapsed, path=path)
         if self.stats is not None:
             self.stats.timing("executor_readback_seconds", elapsed)
         return elapsed
@@ -358,7 +385,10 @@ class Executor:
         """(route, estimated_work_words) for one top-level call.  Writes
         route None (no engine choice to make); Rows is metadata-only and
         always serves host-side.  Reads go through the cost router —
-        decision memoized per plan key (executor/router.py)."""
+        decision memoized per plan key (executor/router.py) — which picks
+        among host, the single-program device path, and (when a
+        multi-device MeshContext is attached and the call tree compiles
+        to mesh programs) the explicit-SPMD mesh path."""
         c, sh = call, shards
         while c.name == "Options" and len(c.children) == 1:
             sh = c.arg("shards", sh)
@@ -369,9 +399,35 @@ class Executor:
             return "host", 0
         n = len(sh) if sh is not None else max(1, len(idx.available_shards()))
         work = estimate_words(idx, c, n)
+        mesh_ok = self._mesh_ok(c, n)
         if self.router.mode != "auto":
-            return self.router.mode, work
-        return self.router.decide((idx.name, n, repr(c)), work), work
+            mode = self.router.mode
+            if mode == "mesh" and not mesh_ok:
+                # fallback-annotated call type (parallel.mesh) or a
+                # replicate-only shape: the single-program device path
+                # serves it (still SPMD via the stacks' NamedSharding)
+                mode = "device"
+                if self.compiler.mesh_engine is not None:
+                    self.compiler.mesh_engine.note_fallback()
+            return mode, work
+        return (
+            self.router.decide((idx.name, n, repr(c)), work, mesh_ok=mesh_ok),
+            work,
+        )
+
+    def _mesh_ok(self, call: Call, n_shards: int) -> bool:
+        """Can this call run as explicit mesh programs right now — a mesh
+        engine is attached, the shard/word shapes actually shard onto it,
+        and every node of the tree has a mesh program (no fallback
+        annotations)?  Deferred import: executor modules must not pull
+        parallel/ in at import time."""
+        if self.compiler.mesh_engine is None:
+            return False
+        if self.compiler.mesh_mode(n_shards) is None:
+            return False
+        from pilosa_tpu.parallel.mesh import mesh_supported
+
+        return mesh_supported(call)
 
     def route_for(
         self,
@@ -415,6 +471,9 @@ class Executor:
             return self._execute_write(idx, call)
         shard_list = self._shards(idx, shards)
         host = route == "host"
+        # trust-but-verify the mesh route: the decision was made with
+        # _mesh_ok, but a direct caller may pass route="mesh" blindly
+        mesh = route == "mesh" and self.compiler.mesh_engine is not None
         try:
             if name in BITMAP_CALLS:
                 if host:
@@ -423,6 +482,10 @@ class Executor:
                     # not alias storage a later write scatters into
                     words = np.array(
                         self.compiler.host.bitmap_words(idx, call, shard_list)
+                    )
+                elif mesh:
+                    words = self.compiler.mesh_bitmap_words(
+                        idx, call, shard_list
                     )
                 else:
                     words = self._bitmap_words(idx, call, shard_list)
@@ -440,28 +503,44 @@ class Executor:
                     return self.compiler.host.count(
                         idx, call.children[0], shard_list
                     )
-                pend = _Pending(
-                    [self.compiler.count_async(idx, call.children[0], shard_list)],
-                    lambda a: int(a[0]),
-                )
+                if mesh:
+                    pend = _Pending(
+                        [
+                            self.compiler.mesh_count_async(
+                                idx, call.children[0], shard_list
+                            )
+                        ],
+                        lambda a: int(a[0]),
+                        route="mesh",
+                    )
+                else:
+                    pend = _Pending(
+                        [
+                            self.compiler.count_async(
+                                idx, call.children[0], shard_list
+                            )
+                        ],
+                        lambda a: int(a[0]),
+                    )
                 return pend if lazy else pend.resolve_now()
             if name == "Sum":
                 return self._execute_sum(
-                    idx, call, shard_list, lazy=lazy, host=host
+                    idx, call, shard_list, lazy=lazy, host=host, mesh=mesh
                 )
             if name in ("Min", "Max"):
                 return self._execute_min_max(
-                    idx, call, shard_list, name == "Max", lazy=lazy, host=host
+                    idx, call, shard_list, name == "Max", lazy=lazy,
+                    host=host, mesh=mesh,
                 )
             if name == "TopN":
                 return self._execute_topn(
-                    idx, call, shard_list, lazy=lazy, host=host
+                    idx, call, shard_list, lazy=lazy, host=host, mesh=mesh
                 )
             if name == "Rows":
                 return self._execute_rows(idx, call, shard_list)
             if name == "GroupBy":
                 return self._execute_group_by(
-                    idx, call, shard_list, lazy=lazy, host=host
+                    idx, call, shard_list, lazy=lazy, host=host, mesh=mesh
                 )
             if name == "IncludesColumn":
                 return self._execute_includes_column(
@@ -558,17 +637,32 @@ class Executor:
                 raise ExecutionError(str(e)) from e
         return self.compiler.ones(len(shards))
 
-    def _filter_plan(self, idx: Index, call: Call, shards: list[int]):
+    def _filter_plan(
+        self,
+        idx: Index,
+        call: Call,
+        shards: list[int],
+        mesh_mode: str | None = None,
+    ):
         """Plan a filter child for IN-PROGRAM fusion: (run, arrays,
         scalars, skey), or None when the call has no filter. The filter
         expression computes inside the aggregate's own XLA program, so
         the [S, W] filter never materializes to HBM between two
         dispatches (VERDICT r3 weak #2: the separate filter program was
-        part of the executor-vs-raw-kernel bandwidth gap)."""
+        part of the executor-vs-raw-kernel bandwidth gap).  With
+        ``mesh_mode`` the closure traces against the mesh's per-device
+        block shape so it can fuse into a shard_map program."""
         if not call.children:
             return None
         try:
-            planner, run, skey = self.compiler._plan(idx, call.children[0], shards)
+            if mesh_mode is not None:
+                planner, run, skey = self.compiler.mesh_plan(
+                    idx, call.children[0], shards, mesh_mode
+                )
+            else:
+                planner, run, skey = self.compiler._plan(
+                    idx, call.children[0], shards
+                )
         except PlanError as e:
             raise ExecutionError(str(e)) from e
         arrays = planner.materialize()
@@ -615,37 +709,62 @@ class Executor:
 
     def _execute_sum(
         self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
-        host: bool = False,
+        host: bool = False, mesh: bool = False,
     ):
         field = self._agg_field(idx, call)
         if host:
             value, n = self.compiler.host.sum(idx, field, call, shards)
             return SumCount(value, n)
         slices = self._bsi_stacked(idx, field, shards)
-        fplan = self._filter_plan(idx, call, shards)
-        if fplan is not None:
-            frun, farrays, fscalars, fskey = fplan
-            pos, neg, n = self.compiler.run_program(
-                ("sum", len(shards), field.bit_depth, fskey),
-                lambda: jax.jit(
-                    lambda s, fa, fs: self._sum_fn(s, frun(fa, fs))
-                ),
-                slices,
-                farrays,
-                fscalars,
-            )
+        if mesh:
+            mode = self.compiler.mesh_mode(len(shards))
+            eng = self.compiler.mesh_engine
+            fplan = self._filter_plan(idx, call, shards, mesh_mode=mode)
+            if fplan is not None:
+                frun, farrays, fscalars, fskey = fplan
+                key = ("mesh_sum", len(shards), field.bit_depth, mode, fskey)
+                prog = self.compiler.program(
+                    key, lambda: eng.sum_tree(self._sum_fn, mode, frun=frun)
+                )
+                pos, neg, n = self.compiler._mesh_dispatch(
+                    "sum", key, prog, slices, farrays, fscalars
+                )
+            else:
+                key = ("mesh_sum", len(shards), field.bit_depth, mode)
+                prog = self.compiler.program(
+                    key, lambda: eng.sum_tree(self._sum_fn, mode)
+                )
+                pos, neg, n = self.compiler._mesh_dispatch(
+                    "sum", key, prog, slices, self.compiler.ones(len(shards))
+                )
         else:
-            filt = self.compiler.ones(len(shards))
-            pos, neg, n = self._sum_program(field, len(shards))(slices, filt)
+            fplan = self._filter_plan(idx, call, shards)
+            if fplan is not None:
+                frun, farrays, fscalars, fskey = fplan
+                pos, neg, n = self.compiler.run_program(
+                    ("sum", len(shards), field.bit_depth, fskey),
+                    lambda: jax.jit(
+                        lambda s, fa, fs: self._sum_fn(s, frun(fa, fs))
+                    ),
+                    slices,
+                    farrays,
+                    fscalars,
+                )
+            else:
+                filt = self.compiler.ones(len(shards))
+                pos, neg, n = self._sum_program(field, len(shards))(
+                    slices, filt
+                )
         pend = _Pending(
             [pos, neg, n],
             lambda a: SumCount(ops.bsi.weigh_sum(a[0], a[1]), int(a[2])),
+            route="mesh" if mesh else "device",
         )
         return pend if lazy else pend.resolve_now()
 
     def _execute_min_max(
         self, idx: Index, call: Call, shards: list[int], want_max: bool,
-        lazy: bool = False, host: bool = False,
+        lazy: bool = False, host: bool = False, mesh: bool = False,
     ):
         field = self._agg_field(idx, call)
         if host:
@@ -654,27 +773,61 @@ class Executor:
             )
             return SumCount(value, n)
         slices = self._bsi_stacked(idx, field, shards)
-        vmapped = jax.vmap(
-            lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
-            in_axes=(1, 0),
-        )
-        fplan = self._filter_plan(idx, call, shards)
-        if fplan is not None:
-            frun, farrays, fscalars, fskey = fplan
-            values, counts = self.compiler.run_program(
-                ("minmax", len(shards), field.bit_depth, want_max, fskey),
-                lambda: jax.jit(lambda s, fa, fs: vmapped(s, frun(fa, fs))),
-                slices,
-                farrays,
-                fscalars,
-            )
+        if mesh:
+            # per-device-block extremes, all-gathered: finish() below
+            # merges them exactly like per-shard partials (min/max with
+            # count merges associatively over disjoint column blocks)
+            mode = self.compiler.mesh_mode(len(shards))
+            eng = self.compiler.mesh_engine
+            fplan = self._filter_plan(idx, call, shards, mesh_mode=mode)
+            if fplan is not None:
+                frun, farrays, fscalars, fskey = fplan
+                key = (
+                    "mesh_minmax", len(shards), field.bit_depth, want_max,
+                    mode, fskey,
+                )
+                prog = self.compiler.program(
+                    key, lambda: eng.minmax_tree(want_max, mode, frun=frun)
+                )
+                values, counts = self.compiler._mesh_dispatch(
+                    "minmax", key, prog, slices, farrays, fscalars
+                )
+            else:
+                key = (
+                    "mesh_minmax", len(shards), field.bit_depth, want_max,
+                    mode,
+                )
+                prog = self.compiler.program(
+                    key, lambda: eng.minmax_tree(want_max, mode)
+                )
+                values, counts = self.compiler._mesh_dispatch(
+                    "minmax", key, prog, slices,
+                    self.compiler.ones(len(shards)),
+                )
         else:
-            values, counts = self.compiler.run_program(
-                ("minmax", len(shards), field.bit_depth, want_max),
-                lambda: jax.jit(lambda s, f: vmapped(s, f)),
-                slices,
-                self.compiler.ones(len(shards)),
+            vmapped = jax.vmap(
+                lambda ss, ff: ops.bsi.min_max(ss, ff, want_max=want_max),
+                in_axes=(1, 0),
             )
+            fplan = self._filter_plan(idx, call, shards)
+            if fplan is not None:
+                frun, farrays, fscalars, fskey = fplan
+                values, counts = self.compiler.run_program(
+                    ("minmax", len(shards), field.bit_depth, want_max, fskey),
+                    lambda: jax.jit(
+                        lambda s, fa, fs: vmapped(s, frun(fa, fs))
+                    ),
+                    slices,
+                    farrays,
+                    fscalars,
+                )
+            else:
+                values, counts = self.compiler.run_program(
+                    ("minmax", len(shards), field.bit_depth, want_max),
+                    lambda: jax.jit(lambda s, f: vmapped(s, f)),
+                    slices,
+                    self.compiler.ones(len(shards)),
+                )
 
         def finish(a):
             best, best_count = None, 0
@@ -687,12 +840,14 @@ class Executor:
                     best_count += n
             return SumCount(best if best is not None else 0, best_count)
 
-        pend = _Pending([values, counts], finish)
+        pend = _Pending(
+            [values, counts], finish, route="mesh" if mesh else "device"
+        )
         return pend if lazy else pend.resolve_now()
 
     def _execute_topn(
         self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
-        host: bool = False,
+        host: bool = False, mesh: bool = False,
     ):
         field = self._field(idx, self._call_field_name(call))
         n = call.arg("n")
@@ -724,6 +879,7 @@ class Executor:
             # streamed (over-budget) path: chunk readbacks are the
             # streaming discipline itself, so it stays synchronous; the
             # filter materializes ONCE and is reused across every chunk
+            # (mesh route included — the stream IS the fallback)
             filt = self._filter_device(idx, call, shards)
             pairs = self._topn_chunked(
                 idx, field, shards, filt, ids=ids
@@ -731,10 +887,34 @@ class Executor:
             return self._topn_finish(
                 field, pairs, n, attr_name, attr_values, min_count
             )
-        fplan = self._filter_plan(idx, call, shards)
+        mesh_mode = self.compiler.mesh_mode(len(shards)) if mesh else None
+        fplan = self._filter_plan(idx, call, shards, mesh_mode=mesh_mode)
         if ids is not None:
             row_ids = jnp.asarray(ids, jnp.int32)
-            if fplan is not None:
+            if mesh:
+                eng = self.compiler.mesh_engine
+                filtered = fplan is not None
+                key = ("mesh_topn_ids", len(shards), mesh_mode) + (
+                    (fplan[3],) if filtered else ()
+                )
+                prog = self.compiler.program(
+                    key,
+                    lambda: eng.topn_tree(
+                        mesh_mode,
+                        filtered,
+                        True,
+                        frun=fplan[0] if filtered else None,
+                    ),
+                )
+                if filtered:
+                    counts = self.compiler._mesh_dispatch(
+                        "topn", key, prog, matrix, row_ids, fplan[1], fplan[2]
+                    )
+                else:
+                    counts = self.compiler._mesh_dispatch(
+                        "topn", key, prog, matrix, row_ids
+                    )
+            elif fplan is not None:
                 frun, farrays, fscalars, fskey = fplan
                 counts = self.compiler.run_program(
                     ("topn_ids", len(shards), fskey),
@@ -776,7 +956,30 @@ class Executor:
                 )
 
         else:
-            if fplan is not None:
+            if mesh:
+                eng = self.compiler.mesh_engine
+                filtered = fplan is not None
+                key = ("mesh_topn", len(shards), mesh_mode) + (
+                    (fplan[3],) if filtered else ()
+                )
+                prog = self.compiler.program(
+                    key,
+                    lambda: eng.topn_tree(
+                        mesh_mode,
+                        filtered,
+                        False,
+                        frun=fplan[0] if filtered else None,
+                    ),
+                )
+                if filtered:
+                    counts = self.compiler._mesh_dispatch(
+                        "topn", key, prog, matrix, fplan[1], fplan[2]
+                    )
+                else:
+                    counts = self.compiler._mesh_dispatch(
+                        "topn", key, prog, matrix
+                    )
+            elif fplan is not None:
                 frun, farrays, fscalars, fskey = fplan
                 # filter computes INSIDE this program — no separate
                 # dispatch, no [S, W] HBM round trip
@@ -813,7 +1016,7 @@ class Executor:
                     field, pairs, n, attr_name, attr_values, min_count
                 )
 
-        pend = _Pending([counts], finish)
+        pend = _Pending([counts], finish, route="mesh" if mesh else "device")
         return pend if lazy else pend.resolve_now()
 
     @staticmethod
@@ -922,9 +1125,39 @@ class Executor:
             }
         return {"rows": rows}
 
+    def _gb_programs(self, mesh_mode: str | None):
+        """(gb_counts, gb_masks) program callables for one GroupBy
+        execution: the single-program jitted pair, or the mesh engine's
+        shard_map pair (same bodies, psum merge tree) when the query
+        routed mesh — every call site below stays engine-agnostic."""
+        if mesh_mode is None:
+            gbc = lambda masks, m, rows: self.compiler.call_program(
+                ("gb_counts",), _gb_counts, masks, m, rows
+            )
+            gbm = lambda masks, m, g_idx, row_sel: self.compiler.call_program(
+                ("gb_masks",), _gb_masks, masks, m, g_idx, row_sel
+            )
+            return gbc, gbm
+        eng = self.compiler.mesh_engine
+        ckey = ("mesh_gb_counts", mesh_mode)
+        cprog = self.compiler.program(
+            ckey, lambda: eng.groupby_counts_tree(mesh_mode)
+        )
+        mkey = ("mesh_gb_masks", mesh_mode)
+        mprog = self.compiler.program(
+            mkey, lambda: eng.groupby_masks_tree(mesh_mode)
+        )
+        gbc = lambda masks, m, rows: self.compiler._mesh_dispatch(
+            "groupby", ckey, cprog, masks, m, rows
+        )
+        gbm = lambda masks, m, g_idx, row_sel: self.compiler._mesh_dispatch(
+            "groupby", mkey, mprog, masks, m, g_idx, row_sel
+        )
+        return gbc, gbm
+
     def _execute_group_by(
         self, idx: Index, call: Call, shards: list[int], lazy: bool = False,
-        host: bool = False,
+        host: bool = False, mesh: bool = False,
     ):
         if not call.children or any(ch.name != "Rows" for ch in call.children):
             raise ExecutionError("GroupBy() takes Rows() calls")
@@ -983,10 +1216,17 @@ class Executor:
                 # (same discipline as _topn_chunked; VERDICT r2 item 4)
                 matrices.append(None)
 
+        mesh_mode = self.compiler.mesh_mode(len(shards)) if mesh else None
+        gb_counts_call, gb_masks_call = self._gb_programs(mesh_mode)
         if filter_call is not None:
-            base_mask = self._filter_device(
-                idx, Call("_", {}, [filter_call]), shards
-            )
+            if mesh_mode is not None:
+                base_mask = self.compiler.mesh_bitmap_device(
+                    idx, filter_call, shards
+                )
+            else:
+                base_mask = self._filter_device(
+                    idx, Call("_", {}, [filter_call]), shards
+                )
         else:
             base_mask = self.compiler.ones(len(shards))
 
@@ -996,7 +1236,8 @@ class Executor:
             and all(row_lists)
         ):
             fused = self._groupby_fused(
-                fields, row_lists, matrices, base_mask, limit, len(shards)
+                fields, row_lists, matrices, base_mask, limit, len(shards),
+                gb_counts_call, gb_masks_call, route_mesh=mesh_mode is not None,
             )
             if fused is not None:
                 return fused if lazy else fused.resolve_now()
@@ -1022,11 +1263,22 @@ class Executor:
         chunk_cap = 1 << (chunk_cap.bit_length() - 1)
 
         results: list[dict] = []
-        sum_prog = (
-            self._grouped_sum_program(agg_field, n_shards)
-            if agg_slices is not None
-            else None
-        )
+        sum_prog = None
+        if agg_slices is not None:
+            if mesh_mode is not None:
+                eng = self.compiler.mesh_engine
+                gskey = (
+                    "mesh_gb_sums", n_shards, agg_field.bit_depth, mesh_mode,
+                )
+                gsp = self.compiler.program(
+                    gskey,
+                    lambda: eng.grouped_sum_tree(self._sum_fn, mesh_mode),
+                )
+                sum_prog = lambda s, m: self.compiler._mesh_dispatch(
+                    "groupby", gskey, gsp, s, m
+                )
+            else:
+                sum_prog = self._grouped_sum_program(agg_field, n_shards)
 
         def emit(groups: list[tuple], counts: np.ndarray, masks) -> None:
             start = len(results)
@@ -1094,9 +1346,7 @@ class Executor:
                 k_pad = _pow2(len(rows_l))
                 rows_arr = _pad_row_ids(rows_l, k_pad)
                 return np.asarray(
-                    self.compiler.call_program(
-                        ("gb_counts",), _gb_counts, masks, m, jnp.asarray(rows_arr)
-                    )
+                    gb_counts_call(masks, m, jnp.asarray(rows_arr))
                 )[:n_groups, : len(rows_l)]
             frags = _level_frags(level)
             hot = self.compiler.stacks.hot_capacity(n_shards)
@@ -1107,9 +1357,7 @@ class Executor:
                 host = _pack_rows(level, frags, sub, k_pad)
                 parts.append(
                     np.asarray(
-                        self.compiler.call_program(
-                            ("gb_counts",),
-                            _gb_counts,
+                        gb_counts_call(
                             masks,
                             jnp.asarray(host),
                             jnp.arange(k_pad, dtype=jnp.int32),
@@ -1141,8 +1389,8 @@ class Executor:
                 row_sel[: chunk.shape[0]] = np.searchsorted(uniq_k, chunk[:, 1])
             else:
                 row_sel[: chunk.shape[0]] = [rows_l[k] for k in chunk[:, 1]]
-            return self.compiler.call_program(
-                ("gb_masks",), _gb_masks, masks, m, jnp.asarray(g_idx), jnp.asarray(row_sel)
+            return gb_masks_call(
+                masks, m, jnp.asarray(g_idx), jnp.asarray(row_sel)
             )
 
         def expand(level: int, masks, groups: list[tuple]) -> None:
@@ -1182,7 +1430,8 @@ class Executor:
         return results
 
     def _groupby_fused(
-        self, fields, row_lists, matrices, base_mask, limit, n_shards
+        self, fields, row_lists, matrices, base_mask, limit, n_shards,
+        gb_counts_call, gb_masks_call, route_mesh: bool = False,
     ):
         """All-pairs GroupBy: fold every level but the last into one
         [G, S, W] pair-mask tensor with zero intermediate readbacks, then
@@ -1214,9 +1463,7 @@ class Executor:
                 return None
             rows_arr = _pad_row_ids(row_lists[lvl], kp[lvl])
             g_idx = np.repeat(np.arange(G, dtype=np.int32), kp[lvl])
-            masks = self.compiler.call_program(
-                ("gb_masks",),
-                _gb_masks,
+            masks = gb_masks_call(
                 masks,
                 matrices[lvl],
                 jnp.asarray(g_idx),
@@ -1225,9 +1472,7 @@ class Executor:
             G = g_new
         last = len(fields) - 1
         rows_arr = _pad_row_ids(row_lists[last], kp[last])
-        counts = self.compiler.call_program(
-            ("gb_counts",), _gb_counts, masks, matrices[last], jnp.asarray(rows_arr)
-        )
+        counts = gb_counts_call(masks, matrices[last], jnp.asarray(rows_arr))
 
         def finish(a):
             cnt = a[0]  # [G, kp[last]]
@@ -1253,7 +1498,9 @@ class Executor:
                 )
             return results
 
-        return _Pending([counts], finish)
+        return _Pending(
+            [counts], finish, route="mesh" if route_mesh else "device"
+        )
 
     # ------------------------------------------------------------ writes
     def _execute_includes_column(

@@ -53,7 +53,7 @@ from pilosa_tpu.pql import Call
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
 from pilosa_tpu.utils.stats import Ewma, Histogram
 
-ROUTE_MODES = ("auto", "host", "device")
+ROUTE_MODES = ("auto", "host", "device", "mesh")
 
 # calibration drift that invalidates memoized decisions
 _DRIFT = 0.25
@@ -75,6 +75,8 @@ class QueryRouter:
         host_wps: float | None = None,
         crossover_words: float = 0.0,
         alpha: float = 0.3,
+        mesh_dispatch_seed_s: float = 2e-3,
+        mesh_readback_seed_s: float = 2e-3,
     ):
         if mode is None:
             mode = os.environ.get("PILOSA_TPU_ROUTE_MODE", "") or "auto"
@@ -98,6 +100,19 @@ class QueryRouter:
         # query cannot flip every subsequent routing decision
         self._dispatch_hist = Histogram()
         self._readback_hist = Histogram()
+        # third path: explicit-SPMD mesh programs (docs/spmd.md). Its
+        # own dispatch/readback EWMAs — shard_map programs pay different
+        # issue overhead than single-program jit (collective setup) and
+        # their readbacks gather replicated results — and a device-count
+        # throughput multiplier: the per-word scan rate scales with the
+        # chips actually working the query. mesh_devices stays 1 until a
+        # MeshContext attaches (Executor/API set it), which disables the
+        # mesh path entirely.
+        self.mesh_devices = 1
+        self.mesh_dispatch_s = Ewma(alpha, mesh_dispatch_seed_s)
+        self.mesh_readback_s = Ewma(alpha, mesh_readback_seed_s)
+        self._mesh_dispatch_hist = Histogram()
+        self._mesh_readback_hist = Histogram()
         # cross-query wave occupancy (executor/scheduler.py feeds it):
         # when concurrent sync queries share readback waves, the per-
         # query device overhead is the wave total divided by occupancy —
@@ -118,11 +133,13 @@ class QueryRouter:
             "readback": self.readback_s.value,
             "host_overhead": self.host_overhead_s.value,
             "wave_occupancy": self.wave_occupancy.value,
+            "mesh_dispatch": self.mesh_dispatch_s.value,
+            "mesh_readback": self.mesh_readback_s.value,
         }
         if self.host_wps.value is not None:
             self._snapshots["host_wps"] = self.host_wps.value
         self._observes = 0
-        self.decisions = {"host": 0, "device": 0}
+        self.decisions = {"host": 0, "device": 0, "mesh": 0}
 
     # ----------------------------------------------------------- calibration
     def _calibrate_host(self) -> float:
@@ -183,6 +200,14 @@ class QueryRouter:
                 "dispatch",
                 self.dispatch_s.update(self._dispatch_hist.percentile(0.5)),
             )
+        elif route == "mesh":
+            self._mesh_dispatch_hist.observe(seconds)
+            self._note_drift(
+                "mesh_dispatch",
+                self.mesh_dispatch_s.update(
+                    self._mesh_dispatch_hist.percentile(0.5)
+                ),
+            )
         self._bump_observes()
 
     def observe_wave(self, queries: int) -> None:
@@ -195,8 +220,18 @@ class QueryRouter:
             "wave_occupancy", self.wave_occupancy.update(float(queries))
         )
 
-    def observe_readback(self, seconds: float) -> None:
+    def observe_readback(self, seconds: float, path: str = "device") -> None:
         if seconds <= 0:
+            return
+        if path == "mesh":
+            self._mesh_readback_hist.observe(seconds)
+            self._note_drift(
+                "mesh_readback",
+                self.mesh_readback_s.update(
+                    self._mesh_readback_hist.percentile(0.5)
+                ),
+            )
+            self._bump_observes()
             return
         self._readback_hist.observe(seconds)
         self._note_drift(
@@ -257,6 +292,19 @@ class QueryRouter:
             + work_words / self.device_wps
         )
 
+    def mesh_cost(self, work_words: float) -> float:
+        """Explicit-SPMD path: its own measured dispatch/readback EWMAs,
+        and the scan term divided by the device count — the mesh's whole
+        point is that every chip reads a disjoint slice of the words.
+        The readback amortizes over wave occupancy exactly like the
+        device path (mesh pendings ride the same waves)."""
+        occ = max(1.0, self.wave_occupancy.value or 1.0)
+        return (
+            self.mesh_dispatch_s.value
+            + self.mesh_readback_s.value / occ
+            + work_words / (self.device_wps * max(1, self.mesh_devices))
+        )
+
     def crossover_words(self) -> float:
         """Work level where the two cost curves meet — the calibrated
         crossover the profile/debug surfaces report."""
@@ -273,13 +321,16 @@ class QueryRouter:
             return float("inf")  # host never slower per word: always host
         return max(0.0, overhead) / per_word
 
-    def decide(self, key: tuple, work_words: int) -> str:
+    def decide(self, key: tuple, work_words: int, mesh_ok: bool = False) -> str:
         if self.mode != "auto":
             return self.mode
+        mesh_ok = mesh_ok and self.mesh_devices > 1
         # the work estimate is part of the memo identity (bucketed by
         # power of two): the same plan over grown data must re-evaluate
-        # even when calibration hasn't drifted
-        key = key + (int(work_words).bit_length(),)
+        # even when calibration hasn't drifted. mesh_ok joins the key —
+        # the same plan may be mesh-eligible on one shard subset and not
+        # another (divisibility), and the memo must not cross them.
+        key = key + (int(work_words).bit_length(), mesh_ok)
         memo = self._memo.get(key)
         if memo is not None and memo[0] == self._gen:
             return memo[1]
@@ -287,12 +338,20 @@ class QueryRouter:
             route = (
                 "host" if work_words <= self.crossover_override else "device"
             )
+            if route == "device" and mesh_ok and self.mesh_cost(
+                work_words
+            ) < self.device_cost(work_words):
+                route = "mesh"
         else:
-            route = (
-                "host"
-                if self.host_cost(work_words) <= self.device_cost(work_words)
-                else "device"
-            )
+            costs = [
+                (self.host_cost(work_words), "host"),
+                (self.device_cost(work_words), "device"),
+            ]
+            if mesh_ok:
+                costs.append((self.mesh_cost(work_words), "mesh"))
+            # stable min: ties keep the earlier (host-first) entry, so
+            # the pre-mesh host/device behavior is unchanged bit for bit
+            route = min(costs, key=lambda cr: cr[0])[1]
         with self._lock:
             if len(self._memo) >= 4096:
                 self._memo.clear()
@@ -322,6 +381,9 @@ class QueryRouter:
             "hostWordsPerSecond": self.host_wps.value,
             "deviceWordsPerSecond": self.device_wps,
             "waveOccupancy": self.wave_occupancy.value,
+            "meshDevices": self.mesh_devices,
+            "meshDispatchSeconds": self.mesh_dispatch_s.value,
+            "meshReadbackSeconds": self.mesh_readback_s.value,
             "decisions": dict(self.decisions),
         }
 

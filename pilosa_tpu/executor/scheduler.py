@@ -401,7 +401,10 @@ class WaveScheduler:
                 return False, None
             rw = executor._route(idx, c, shards)
             routes.append(rw)
-            if rw[0] == "device":
+            if rw[0] in ("device", "mesh"):
+                # mesh-routed queries batch too: their pendings ride the
+                # same readback wave, so chip parallelism compounds with
+                # cross-query coalescing (docs/spmd.md)
                 any_device = True
         # host-routed calls bypass the window: no readback wave to
         # share, so queueing would only add latency (docs/query-batching.md)

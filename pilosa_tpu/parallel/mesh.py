@@ -28,20 +28,77 @@ merge collapses into one collective).
 from __future__ import annotations
 
 import functools
+import threading
 
 import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pilosa_tpu import ops
 from pilosa_tpu.ops import bsi as bsi_ops
+from pilosa_tpu.parallel import shard_map  # THE compat shim (jax 0.4/0.5+)
 
 AXIS_SHARDS = "shards"
 AXIS_WORDS = "words"
 _BOTH = (AXIS_SHARDS, AXIS_WORDS)
+
+# ----------------------------------------------------- mesh read coverage
+# The serving-path SPMD surface (docs/spmd.md). The analyzer's parity
+# rule diffs these literals against the executor's BITMAP_CALLS: every
+# bitmap call type must either have a MeshQueryEngine program (its
+# planner closure runs inside shard_map) or carry an explicit fallback
+# annotation here — a silent gap would 500 (or worse, mis-reduce) the
+# day the router sends that call type down the mesh path.
+MESH_PROGRAMS = {
+    "Row",
+    "Range",
+    "Union",
+    "Intersect",
+    "Difference",
+    "Xor",
+    "Not",
+    "All",
+}
+# Aggregates served as mesh programs (psum/all_gather reduction trees —
+# the multi-node merge transforms, intra-mesh and on-device).
+MESH_AGGREGATES = {"Count", "Sum", "Min", "Max", "TopN", "GroupBy"}
+# Host-fallback annotations: call types the mesh route hands back to the
+# single-program device path (which still executes SPMD via the stacks'
+# NamedSharding — GSPMD inserts the cross-device carries shard_map makes
+# explicit).
+#   Shift — the cross-word bit carry (ops.bitwise.shift_words rolls the
+#   packed word axis) crosses device boundaries whenever the words axis
+#   is split; expressing it under shard_map needs a words-axis
+#   collective-permute chain that buys nothing for a metadata-rare call.
+MESH_FALLBACK_CALLS = {"Shift"}
+
+
+def mesh_supported(call) -> bool:
+    """Can this call tree execute as explicit mesh (shard_map) programs?
+
+    Walks the whole tree — a fallback-annotated call anywhere (e.g. a
+    Shift inside an Intersect) sends the full query down the device
+    path, since a mesh program cannot splice a non-SPMD subexpression.
+    GroupBy's Rows() children and its aggregate=Sum() argument are row
+    universes / aggregate specs, not bitmap subtrees — only their own
+    filter children matter."""
+    name = call.name
+    if name == "Options":
+        return all(mesh_supported(ch) for ch in call.children)
+    if name in MESH_FALLBACK_CALLS:
+        return False
+    if name == "GroupBy":
+        filt = call.arg("filter")
+        if filt is not None and hasattr(filt, "name") and not mesh_supported(filt):
+            return False
+        return all(
+            ch.name == "Rows" or mesh_supported(ch) for ch in call.children
+        )
+    if name in MESH_PROGRAMS or name in MESH_AGGREGATES:
+        return all(mesh_supported(ch) for ch in call.children)
+    return False
 
 
 def make_mesh(devices=None, words_axis: int = 1) -> Mesh:
@@ -167,11 +224,312 @@ class MeshContext:
 
 
 class MeshQueryEngine:
-    """Compiles and caches sharded query programs over a fixed mesh."""
+    """Compiles and caches sharded query programs over a fixed mesh.
+
+    Two program families live here:
+
+    - the concrete demo/bench programs (count_and, topn, bsi_sum,
+      tanimoto/cosine, ingest_and_aggregate) — fixed signatures, used by
+      dryrun_multichip, the examples and the multichip bench;
+    - the serving-path program BUILDERS (bitmap_tree, count_tree,
+      topn_tree, sum_tree, minmax_tree, groupby_*_tree, …): each takes a
+      query-compiler planner closure and wraps it in ``shard_map`` over
+      this mesh, turning the whole PQL read call into one SPMD program
+      whose reduction is a psum tree over ICI (words — the minor/fast
+      axis — first, then shards). The executor caches the built
+      programs per structural key and AOT-compiles through
+      QueryCompiler.call_program like every other program.
+    """
 
     def __init__(self, mesh: Mesh):
         self.mesh = mesh
         self._aot: set[tuple] = set()
+        # observability (/debug/vars meshExecution): program builds and
+        # per-program-family call counts; a plain dict under a lock —
+        # executor threads increment concurrently
+        self._stats_lock = threading.Lock()
+        self.programs_built = 0
+        self.calls: dict[str, int] = {}
+        self.fallbacks = 0
+
+    @property
+    def n_devices(self) -> int:
+        return self.mesh.devices.size
+
+    # ------------------------------------------------- placement algebra
+    def spec_mode(self, n_shards: int, n_words: int) -> str | None:
+        """How a [.., S, W] stack maps onto this mesh — the SAME rule as
+        MeshContext._spec, so the specs a program compiles against match
+        the placement the stack cache already gave its arrays:
+
+        - "grid":  S divides the shards axis and W the words axis —
+          whole shard slices per device row (data parallel);
+        - "words": W divides the full device count — the packed word
+          axis spans every device (a 1-shard query still uses the whole
+          mesh);
+        - None:    tiny odd shapes replicate; no mesh program (the
+          device path serves them — psum over replicated data would
+          multiply by the axis size).
+        """
+        if (
+            n_shards % self.mesh.shape[AXIS_SHARDS] == 0
+            and n_words % self.mesh.shape[AXIS_WORDS] == 0
+        ):
+            return "grid"
+        if n_words % self.n_devices == 0:
+            return "words"
+        return None
+
+    def block_shape(self, n_shards: int, n_words: int, mode: str) -> tuple[int, int]:
+        """Per-device (S_local, W_local) block of an [S, W] plane — what
+        planner closures see inside shard_map (zero leaves must be
+        block-shaped, not global)."""
+        if mode == "grid":
+            return (
+                n_shards // self.mesh.shape[AXIS_SHARDS],
+                n_words // self.mesh.shape[AXIS_WORDS],
+            )
+        return (n_shards, n_words // self.n_devices)
+
+    def _arr_spec(self, lead: int, mode: str) -> P:
+        """Spec for an array with ``lead`` unsharded leading dims before
+        its [S, W] plane (stacks are [R, S, W] ⇒ lead=1)."""
+        lead_none = (None,) * lead
+        if mode == "grid":
+            return P(*lead_none, AXIS_SHARDS, AXIS_WORDS)
+        return P(*lead_none, None, _BOTH)
+
+    def row_spec(self, mode: str) -> P:
+        return self._arr_spec(0, mode)
+
+    @staticmethod
+    def _psum_both(v):
+        """The cross-chip reduction tree: words (minor/ICI) hop first,
+        then shards — the multi-node merge transforms' order, intra-mesh."""
+        return jax.lax.psum(jax.lax.psum(v, AXIS_WORDS), AXIS_SHARDS)
+
+    def _spmd(self, local, in_specs, out_specs, check_rep: bool = True):
+        prog = jax.jit(
+            shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_rep=check_rep,
+            )
+        )
+        with self._stats_lock:
+            self.programs_built += 1
+        return prog
+
+    def note_call(self, name: str) -> None:
+        with self._stats_lock:
+            self.calls[name] = self.calls.get(name, 0) + 1
+
+    def note_fallback(self) -> None:
+        with self._stats_lock:
+            self.fallbacks += 1
+
+    def snapshot(self) -> dict:
+        """Live view for /debug/vars (meshExecution)."""
+        with self._stats_lock:
+            calls = dict(self.calls)
+            built, fallbacks = self.programs_built, self.fallbacks
+        return {
+            "devices": self.n_devices,
+            "meshShape": {
+                AXIS_SHARDS: int(self.mesh.shape[AXIS_SHARDS]),
+                AXIS_WORDS: int(self.mesh.shape[AXIS_WORDS]),
+            },
+            "programsBuilt": built,
+            "calls": calls,
+            "fallbacks": fallbacks,
+        }
+
+    # --------------------------------------- serving-path program builders
+    # Each builder closes over a planner closure ``run(arrays, scalars) →
+    # uint32[S_local, W_local]`` (executor/compile.py plans it with this
+    # mesh's block shape) and returns a jitted shard_map program. The
+    # executor caches them per structural key; shapes retrace via jit.
+
+    def bitmap_tree(self, run, mode: str):
+        """(arrays [*,S,W]×N, scalars) → sharded uint32[S, W] — the whole
+        bitmap call tree, elementwise per device block (no collectives)."""
+
+        def local(arrays, scalars):
+            return run(arrays, scalars)
+
+        return self._spmd(
+            local,
+            (self._arr_spec(1, mode), P()),
+            self.row_spec(mode),
+        )
+
+    def count_tree(self, run, mode: str):
+        """(arrays, scalars) → replicated int64 count (psum tree)."""
+
+        def local(arrays, scalars):
+            words = run(arrays, scalars)
+            return self._psum_both(
+                jnp.sum(ops.popcount_rows(words).astype(jnp.int64))
+            )
+
+        return self._spmd(local, (self._arr_spec(1, mode), P()), P())
+
+    def topn_tree(self, mode: str, filtered: bool, ids: bool, frun=None):
+        """Per-row global counts int64[R] (or [K] for ids=), replicated:
+        local masked popcounts, psum over words-then-shards. The filter
+        expression (when present) computes INSIDE the program from its
+        own planner closure — never materialized between dispatches."""
+
+        def row_counts(matrix, filt):
+            m = matrix & filt[None] if filt is not None else matrix
+            return jnp.sum(ops.popcount_rows(m).astype(jnp.int64), axis=1)
+
+        spec3 = self._arr_spec(1, mode)
+        if ids and filtered:
+
+            def local(matrix, row_ids, farrays, fscalars):
+                g = jnp.take(matrix, row_ids, axis=0, mode="fill", fill_value=0)
+                return self._psum_both(row_counts(g, frun(farrays, fscalars)))
+
+            return self._spmd(
+                local, (spec3, P(), spec3, P()), P()
+            )
+        if ids:
+
+            def local(matrix, row_ids):
+                g = jnp.take(matrix, row_ids, axis=0, mode="fill", fill_value=0)
+                return self._psum_both(row_counts(g, None))
+
+            return self._spmd(local, (spec3, P()), P())
+        if filtered:
+
+            def local(matrix, farrays, fscalars):
+                return self._psum_both(
+                    row_counts(matrix, frun(farrays, fscalars))
+                )
+
+            return self._spmd(local, (spec3, spec3, P()), P())
+
+        def local(matrix):
+            return self._psum_both(row_counts(matrix, None))
+
+        return self._spmd(local, (spec3,), P())
+
+    def sum_tree(self, sum_fn, mode: str, frun=None):
+        """BSI Sum: (slices [D,S,W], filter) → (pos[D], neg[D], n),
+        replicated — ``sum_fn`` is Executor._sum_fn, THE one reduction
+        body (host/device/mesh stay in sync by construction)."""
+        spec3 = self._arr_spec(1, mode)
+        if frun is not None:
+
+            def local(slices, farrays, fscalars):
+                pos, neg, n = sum_fn(slices, frun(farrays, fscalars))
+                return (
+                    self._psum_both(pos),
+                    self._psum_both(neg),
+                    self._psum_both(n),
+                )
+
+            return self._spmd(
+                local, (spec3, spec3, P()), (P(), P(), P())
+            )
+
+        def local(slices, filt):
+            pos, neg, n = sum_fn(slices, filt)
+            return (
+                self._psum_both(pos),
+                self._psum_both(neg),
+                self._psum_both(n),
+            )
+
+        return self._spmd(
+            local, (spec3, self.row_spec(mode)), (P(), P(), P())
+        )
+
+    def grouped_sum_tree(self, sum_fn, mode: str):
+        """(slices [D,S,W], masks [G,S,W]) → (pos[G,D], neg[G,D], n[G])
+        replicated — GroupBy's aggregate=Sum under the same psum tree."""
+        spec3 = self._arr_spec(1, mode)
+
+        def local(slices, masks):
+            pos, neg, n = jax.vmap(sum_fn, in_axes=(None, 0))(slices, masks)
+            return (
+                self._psum_both(pos),
+                self._psum_both(neg),
+                self._psum_both(n),
+            )
+
+        return self._spmd(local, (spec3, spec3), (P(), P(), P()))
+
+    def minmax_tree(self, want_max: bool, mode: str, frun=None):
+        """BSI Min/Max: per-device per-shard extremes, all-gathered to a
+        replicated partial list the executor's finish() merges exactly
+        like per-shard device partials (min/max-with-count merges
+        associatively over disjoint column blocks).
+
+        check_rep=False: all_gather's replication isn't statically
+        inferred on the pinned jax — the gather of every block IS full
+        replication, the checker just can't prove it."""
+        spec3 = self._arr_spec(1, mode)
+
+        def gather_all(v):
+            v = jax.lax.all_gather(v, AXIS_WORDS).reshape(-1)
+            return jax.lax.all_gather(v, AXIS_SHARDS).reshape(-1)
+
+        def body(slices, filt):
+            vals, counts = jax.vmap(
+                lambda ss, ff: bsi_ops.min_max(ss, ff, want_max=want_max),
+                in_axes=(1, 0),
+            )(slices, filt)
+            return gather_all(vals), gather_all(counts)
+
+        if frun is not None:
+
+            def local(slices, farrays, fscalars):
+                return body(slices, frun(farrays, fscalars))
+
+            return self._spmd(
+                local, (spec3, spec3, P()), (P(), P()), check_rep=False
+            )
+
+        def local(slices, filt):
+            return body(slices, filt)
+
+        return self._spmd(
+            local,
+            (spec3, self.row_spec(mode)),
+            (P(), P()),
+            check_rep=False,
+        )
+
+    def groupby_counts_tree(self, mode: str):
+        """(masks [G,S,W], matrix [R,S,W], rows [K]) → int64[G,K]
+        replicated — the level-synchronous GroupBy count pass with the
+        per-level merge as one psum tree (executor._gb_counts, intra-mesh)."""
+        spec3 = self._arr_spec(1, mode)
+
+        def local(masks, matrix, rows):
+            gathered = jnp.take(matrix, rows, axis=0, mode="fill", fill_value=0)
+            per_row = lambda rm: jnp.sum(
+                ops.popcount_rows(masks & rm[None]).astype(jnp.int64), axis=1
+            )
+            return self._psum_both(jax.lax.map(per_row, gathered).T)
+
+        return self._spmd(local, (spec3, spec3, P()), P())
+
+    def groupby_masks_tree(self, mode: str):
+        """(masks, matrix, g_idx, row_sel) → sharded [P,S,W] surviving
+        group masks — pure elementwise gather+AND, no collectives."""
+        spec3 = self._arr_spec(1, mode)
+
+        def local(masks, matrix, g_idx, row_sel):
+            sel = jnp.take(masks, g_idx, axis=0)
+            rows = jnp.take(matrix, row_sel, axis=0, mode="fill", fill_value=0)
+            return sel & rows
+
+        return self._spmd(local, (spec3, spec3, P(), P()), spec3)
 
     def _call(self, name: str, prog, *args):
         """Explicit AOT compile per (program, shapes) before the first
@@ -316,6 +674,130 @@ class MeshQueryEngine:
 
     def tanimoto(self, matrix, query, k: int):
         return self._call("tanimoto", self._tanimoto_prog, matrix, query, k)
+
+    @functools.cached_property
+    def _cosine_prog(self):
+        """(matrix [R,S,W], query [S,W]) → (scores f32[k], ids i32[k]) —
+        the cosine twin of the Tanimoto search: same psum tree, scores
+        |a∩q| / sqrt(|a|·|q|) on the replicated count vectors."""
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(None, AXIS_SHARDS, AXIS_WORDS), P(AXIS_SHARDS, AXIS_WORDS)),
+            out_specs=(P(), P(), P()),
+        )
+        def counts_prog(matrix, query):
+            inter = jnp.sum(
+                ops.popcount_rows(matrix & query[None]).astype(jnp.int64),
+                axis=1,
+            )
+            row_pop = jnp.sum(
+                ops.popcount_rows(matrix).astype(jnp.int64), axis=1
+            )
+            q_pop = jnp.sum(ops.popcount_rows(query).astype(jnp.int64))
+            return (
+                self._psum_both(inter),
+                self._psum_both(row_pop),
+                self._psum_both(q_pop),
+            )
+
+        @functools.partial(jax.jit, static_argnums=(2,))
+        def prog(matrix, query, k: int):
+            inter, row_pop, q_pop = counts_prog(matrix, query)
+            denom = jnp.sqrt(
+                row_pop.astype(jnp.float32) * q_pop.astype(jnp.float32)
+            )
+            scores = jnp.where(
+                denom > 0, inter.astype(jnp.float32) / denom, 0.0
+            )
+            k = min(k, scores.shape[0])
+            vals, ids = jax.lax.top_k(scores, k)
+            return vals, ids.astype(jnp.int32)
+
+        return prog
+
+    def cosine(self, matrix, query, k: int):
+        return self._call("cosine", self._cosine_prog, matrix, query, k)
+
+    # ------------------------------------------- all-pairs (MXU) programs
+    # The paper's matmul-shaped workload (arXiv 2112.09017): pairwise
+    # similarity between two fingerprint sets as ONE distributed matmul.
+    # Bits unpack to {0,1} bf16 per device block, the per-block dot
+    # rides the MXU, and the contraction over the split word axis is a
+    # psum — rows of ``a`` stay sharded over the shards axis, so the
+    # [N, M] score matrix never replicates.
+
+    def place_allpairs(self, a: np.ndarray, b: np.ndarray):
+        """(a uint32[N, W], b uint32[M, W]) → placed device pair: a rows
+        sharded over the shards axis (words over words), b replicated
+        over shards (every device row scores its a-slice against all of
+        b). N must divide the shards axis and W the words axis."""
+        if a.shape[0] % self.mesh.shape[AXIS_SHARDS]:
+            raise ValueError(
+                f"N={a.shape[0]} rows not divisible by the shards axis "
+                f"({self.mesh.shape[AXIS_SHARDS]})"
+            )
+        if a.shape[-1] % self.mesh.shape[AXIS_WORDS]:
+            raise ValueError(
+                f"W={a.shape[-1]} words not divisible by the words axis "
+                f"({self.mesh.shape[AXIS_WORDS]})"
+            )
+        a_dev = jax.device_put(
+            a, NamedSharding(self.mesh, P(AXIS_SHARDS, AXIS_WORDS))
+        )
+        b_dev = jax.device_put(
+            b, NamedSharding(self.mesh, P(None, AXIS_WORDS))
+        )
+        return a_dev, b_dev
+
+    def _pairwise_prog(self, kind: str):
+        from pilosa_tpu.ops.similarity import _unpack_bits_bf16
+
+        @functools.partial(
+            shard_map,
+            mesh=self.mesh,
+            in_specs=(P(AXIS_SHARDS, AXIS_WORDS), P(None, AXIS_WORDS)),
+            out_specs=P(AXIS_SHARDS, None),
+        )
+        def prog(a, b):
+            a_bits = _unpack_bits_bf16(a)
+            b_bits = _unpack_bits_bf16(b)
+            inter = jax.lax.psum(
+                jnp.dot(a_bits, b_bits.T, preferred_element_type=jnp.float32),
+                AXIS_WORDS,
+            )
+            a_pop = jax.lax.psum(
+                ops.popcount_rows(a).astype(jnp.float32), AXIS_WORDS
+            )
+            b_pop = jax.lax.psum(
+                ops.popcount_rows(b).astype(jnp.float32), AXIS_WORDS
+            )
+            if kind == "tanimoto":
+                union = a_pop[:, None] + b_pop[None, :] - inter
+                return jnp.where(union > 0, inter / union, 0.0)
+            denom = jnp.sqrt(a_pop[:, None] * b_pop[None, :])
+            return jnp.where(denom > 0, inter / denom, 0.0)
+
+        return jax.jit(prog)
+
+    @functools.cached_property
+    def _pairwise_tanimoto_prog(self):
+        return self._pairwise_prog("tanimoto")
+
+    @functools.cached_property
+    def _pairwise_cosine_prog(self):
+        return self._pairwise_prog("cosine")
+
+    def pairwise_tanimoto(self, a, b):
+        """All-pairs Tanimoto over a placed pair → f32[N, M], rows
+        sharded (ops.similarity.tanimoto_matrix, distributed)."""
+        return self._call(
+            "pairwise_tanimoto", self._pairwise_tanimoto_prog, a, b
+        )
+
+    def pairwise_cosine(self, a, b):
+        return self._call("pairwise_cosine", self._pairwise_cosine_prog, a, b)
 
     @functools.cached_property
     def _bsi_sum_prog(self):

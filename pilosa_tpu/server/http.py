@@ -512,6 +512,9 @@ class Handler(BaseHTTPRequestHandler):
         # cross-query wave coalescing: waves, occupancy, dedup hits
         # (docs/query-batching.md)
         out["queryBatching"] = self.api.scheduler.snapshot()
+        # explicit-SPMD mesh execution: device count, mesh geometry,
+        # per-program-family call counts, fallbacks (docs/spmd.md)
+        out["meshExecution"] = self.api.executor.compiler.mesh_snapshot()
         # serving front end: connection counts, admission queue state,
         # per-class concurrency limits (docs/serving.md)
         out["serving"] = self.server.serving_snapshot()

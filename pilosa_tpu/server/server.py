@@ -60,6 +60,8 @@ class Server:
             readback_seed_s=self.config.route_readback_ms / 1e3,
             device_wps=self.config.route_device_words_per_s,
             crossover_words=self.config.route_crossover_words,
+            mesh_dispatch_seed_s=self.config.route_mesh_dispatch_ms / 1e3,
+            mesh_readback_seed_s=self.config.route_mesh_readback_ms / 1e3,
         )
         # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
         # backend (seconds, or worse on a wedged transport) — that must

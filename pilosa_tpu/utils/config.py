@@ -99,6 +99,12 @@ class Config:
     route_dispatch_ms: float = 1.0  # device dispatch overhead seed
     route_readback_ms: float = 2.0  # device→host readback latency seed
     route_device_words_per_s: float = 25e9  # device scan roofline
+    # mesh (explicit-SPMD) route seeds — the third router path
+    # (docs/spmd.md): shard_map dispatch overhead and collective-readback
+    # latency, refined online like the device seeds; the scan term
+    # divides by the attached mesh's device count
+    route_mesh_dispatch_ms: float = 2.0
+    route_mesh_readback_ms: float = 2.0
     # seconds a persisted device-probe verdict stays valid: within the
     # TTL the next boot (or bench run) reuses it instead of paying the
     # full device-init-timeout probe against a known-wedged transport
@@ -252,6 +258,8 @@ def config_template() -> str:
         "route-dispatch-ms = 1.0\n"
         "route-readback-ms = 2.0\n"
         "route-device-words-per-s = 25e9\n"
+        "route-mesh-dispatch-ms = 2.0\n"
+        "route-mesh-readback-ms = 2.0\n"
         "device-probe-ttl = 900.0\n"
         'batch-mode = "adaptive"\n'
         "batch-window-us = 250.0\n"

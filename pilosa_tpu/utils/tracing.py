@@ -287,6 +287,7 @@ class QueryProfile:
         "calls",
         "fanout",
         "wave",
+        "mesh",
         "_last_rpc_bytes",
     )
 
@@ -299,6 +300,11 @@ class QueryProfile:
         # {"queries": occupancy, "flushReason": ...} — the ?profile=true
         # surface for cross-query coalescing
         self.wave: dict | None = None
+        # set by the executor when a call routed to the explicit-SPMD
+        # mesh path: device count + mesh geometry (the ?profile=true
+        # surface for multi-chip execution; per-call entries carry the
+        # route tag already)
+        self.mesh: dict | None = None
         self._last_rpc_bytes = 0
 
     def add_call(
@@ -366,6 +372,8 @@ class QueryProfile:
         }
         if self.wave is not None:
             out["wave"] = self.wave
+        if self.mesh is not None:
+            out["mesh"] = self.mesh
         if self.trace_id:
             out["traceID"] = self.trace_id
         return out

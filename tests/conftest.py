@@ -61,6 +61,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "spmd: mesh-vs-host equivalence over every PQL read call type on "
+        "the 8-virtual-device mesh (tests/test_mesh_spmd.py; runs in "
+        "tier-1 — the marker exists so `pytest -m spmd` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -184,6 +184,49 @@ def test_parity_missing_planner_branch_fails(tree_copy):
     assert "[parity]" in out and "'Shift'" in out
 
 
+def test_parity_mesh_program_removed_fails(tree_copy):
+    # drop a bitmap call from the mesh read surface WITHOUT a fallback
+    # annotation: the router's mesh path would mis-handle that call type
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "mesh.py",
+        '"Xor",',
+        "",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "'Xor'" in out and "MESH_PROGRAMS" in out
+
+
+def test_parity_mesh_builder_removed_fails(tree_copy):
+    # a missing program builder is a runtime AttributeError on whichever
+    # call family the router sends mesh-side
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "mesh.py",
+        "def minmax_tree(",
+        "def minmax_tree_removed(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "minmax_tree" in out
+
+
+def test_parity_mesh_fallback_annotation_suffices(tree_copy):
+    # moving a call from MESH_PROGRAMS to the fallback annotation set is
+    # an ALLOWED state (explicit, reviewed fallback — not drift)
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "mesh.py",
+        'MESH_FALLBACK_CALLS = {"Shift"}',
+        'MESH_FALLBACK_CALLS = {"Shift", "Xor"}',
+    )
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "mesh.py",
+        '    "Xor",\n',
+        "",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc == 0, out
+
+
 def test_observability_missing_handler_fails(tree_copy):
     mutate(
         tree_copy / "pilosa_tpu" / "server" / "http.py",

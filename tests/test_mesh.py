@@ -8,10 +8,6 @@ import pytest
 
 import jax
 
-if not hasattr(jax, "shard_map"):  # pre-0.5 jax: mesh layer cannot load
-    pytest.skip("jax.shard_map unavailable; mesh path cannot run",
-                allow_module_level=True)
-
 from pilosa_tpu import ops
 from pilosa_tpu.parallel.mesh import MeshQueryEngine, make_mesh
 from pilosa_tpu.roaring import pack_positions

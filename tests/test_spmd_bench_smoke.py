@@ -17,10 +17,6 @@ import pytest
 
 import jax
 
-if not hasattr(jax, "shard_map"):  # pre-0.5 jax: mesh layer cannot load
-    pytest.skip("jax.shard_map unavailable; mesh path cannot run",
-                allow_module_level=True)
-
 from pilosa_tpu.core import Holder
 from pilosa_tpu.executor.executor import Executor
 from pilosa_tpu.parallel.mesh import MeshContext, make_mesh

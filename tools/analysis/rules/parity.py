@@ -42,7 +42,22 @@ from tools.analysis.engine import (
 EXECUTOR = "executor/executor.py"
 HOSTPATH = "executor/hostpath.py"
 SCHEDULER = "executor/scheduler.py"
+MESH = "parallel/mesh.py"
 _EXEMPT = {"Options", "Rows"}
+# program-builder methods the mesh engine must define for the read
+# surface MESH_PROGRAMS/MESH_AGGREGATES claim (executor mesh branches
+# reference them; a missing builder is a runtime AttributeError on
+# whichever call type the router sends mesh-side)
+_MESH_BUILDERS = {
+    "bitmap_tree",
+    "count_tree",
+    "topn_tree",
+    "sum_tree",
+    "grouped_sum_tree",
+    "minmax_tree",
+    "groupby_counts_tree",
+    "groupby_masks_tree",
+}
 
 
 def _set_literal(tree: ast.Module, name: str) -> set[str]:
@@ -265,4 +280,61 @@ def check_parity(project: Project) -> list[Violation]:
                             "diff cannot cover",
                         )
                     )
+
+    # 5. mesh read-surface coverage: every BITMAP_CALLS name must have a
+    # MeshQueryEngine program (MESH_PROGRAMS) or an explicit fallback
+    # annotation (MESH_FALLBACK_CALLS) — the router's mesh path would
+    # otherwise mis-route (or 500) that call type the day it's eligible
+    mesh = project.find(MESH)
+    if mesh is not None and mesh.tree is not None and bitmap_calls:
+        mesh_programs = _set_literal(mesh.tree, "MESH_PROGRAMS")
+        mesh_fallback = _set_literal(mesh.tree, "MESH_FALLBACK_CALLS")
+        if not mesh_programs:
+            out.append(
+                Violation(
+                    "parity",
+                    mesh.rel,
+                    1,
+                    "parallel/mesh.py must declare the MESH_PROGRAMS set "
+                    "literal — the mesh route's read-surface contract",
+                )
+            )
+        else:
+            for name in sorted(
+                bitmap_calls - mesh_programs - mesh_fallback
+            ):
+                out.append(
+                    Violation(
+                        "parity",
+                        mesh.rel,
+                        1,
+                        f"bitmap call {name!r} (executor BITMAP_CALLS) has "
+                        "neither a MeshQueryEngine program (MESH_PROGRAMS) "
+                        "nor a fallback annotation (MESH_FALLBACK_CALLS) — "
+                        "the mesh route would mis-handle this call type",
+                    )
+                )
+        engine_cls = _class(mesh.tree, "MeshQueryEngine")
+        if engine_cls is None:
+            out.append(
+                Violation(
+                    "parity",
+                    mesh.rel,
+                    1,
+                    "parallel/mesh.py must define MeshQueryEngine",
+                )
+            )
+        else:
+            have = set(_methods(engine_cls))
+            for builder in sorted(_MESH_BUILDERS - have):
+                out.append(
+                    Violation(
+                        "parity",
+                        mesh.rel,
+                        engine_cls.lineno,
+                        f"MeshQueryEngine defines no {builder}() — the "
+                        "executor's mesh branch references it, so the mesh "
+                        "route would fail at runtime on that call family",
+                    )
+                )
     return out
