@@ -1,4 +1,4 @@
-.PHONY: check fix test analyze
+.PHONY: check fix test analyze bench-ingest
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -14,3 +14,8 @@ analyze:
 # tier-1 test suite (see ROADMAP.md for the exact CI invocation)
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# mixed ingest+read row + restart-to-serving row (docs/durability.md);
+# exits non-zero when mixed read p95 breaks the 2x read-only gate
+bench-ingest:
+	PILOSA_BENCH_ALL_CHILD=ingest python bench_all.py

@@ -989,6 +989,360 @@ def config8_concurrency_sweep():
         sys.exit(1)
 
 
+def config_ingest():
+    """ISSUE 8: durable ingest under fire (docs/durability.md) — THE
+    mixed-workload row.  An event-front-end server in its own process
+    (bench clients must not share its GIL) serves a config8-style read
+    mix while writer clients sustain bulk imports against the SAME
+    index:
+
+    - read-only baseline: c4 read p95 over the warm index;
+    - mixed phase: same readers concurrent with sustained imports
+      (WAL-mode batch group commit + background compaction both on the
+      hot path); GATE: mixed read p95 ≤ PILOSA_BENCH_INGEST_P95_GUARD
+      (default 2.0) × the read-only baseline, exits non-zero past it —
+      the pre-PR-8 inline snapshot stalled the fragment lock readers
+      repack under, which is exactly the regression this guards;
+    - sustained import throughput (M set-bits/s + import QPS) and the
+      server's compaction counters over the phase (a mixed row whose
+      compactor never ran proves nothing);
+    - restart-to-serving: cold-start the SAME data dir (snapshot
+      deserialize + checked ops-log replay per fragment, parallel
+      holder load, device upload stays lazy) measured three ways —
+      end-to-end child restart to first served query, and in-process
+      Holder.open with serial vs parallel fragment loading."""
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.roaring import Bitmap, serialize
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    rng = np.random.default_rng(80)
+    shards = int(os.environ.get("PILOSA_BENCH_INGEST_SHARDS", "4"))
+    phase_s = float(os.environ.get("PILOSA_BENCH_INGEST_SECONDS", "8"))
+    guard = float(os.environ.get("PILOSA_BENCH_INGEST_P95_GUARD", "2.0"))
+    n = shards * SHARD_WIDTH
+    data_dir = tempfile.mkdtemp()
+    # the config8 read mix: the three dashboard shapes, rotated per
+    # request by each reader client
+    read_mix = [
+        b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3), Row(cab=4)))",
+        b"TopN(cab, n=10)",
+        b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+    ]
+    read_body = read_mix[0]
+
+    child_src = (
+        "import sys\n"
+        "from pilosa_tpu.server import Server\n"
+        "from pilosa_tpu.utils.config import load_config\n"
+        "s = Server(load_config())\n"
+        "s.open()\n"
+        "s.wait_mesh(120)\n"
+        "print('READY', flush=True)\n"
+        "sys.stdin.read()\n"
+        "s.close()\n"
+    )
+
+    def spawn_server(port: int, extra_env: dict | None = None):
+        env = dict(os.environ)
+        env.update({
+            "PILOSA_TPU_BIND": f"127.0.0.1:{port}",
+            "PILOSA_TPU_DATA_DIR": data_dir,
+            "PILOSA_TPU_MAX_WRITES_PER_REQUEST": "500000",
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+            "PILOSA_TPU_DIAGNOSTICS_INTERVAL": "0",
+            # low fold threshold: the row must exercise the background
+            # compactor (sustained ingest at the DEFAULT 2000-op
+            # threshold folds ~never inside a short phase)
+            "PILOSA_TPU_MAX_OP_N": os.environ.get(
+                "PILOSA_BENCH_INGEST_MAX_OP_N", "8"
+            ),
+        })
+        env.update(extra_env or {})
+        child = subprocess.Popen(
+            [sys.executable, "-c", child_src],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        ready = child.stdout.readline().strip()
+        assert ready == "READY", f"ingest server child failed: {ready!r}"
+        return child
+
+    def stop_server(child) -> None:
+        try:
+            child.stdin.close()
+            child.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — bench teardown best-effort
+            child.kill()
+            child.wait(timeout=10)
+
+    def post(port, path, payload):
+        data = (
+            payload
+            if isinstance(payload, bytes)
+            else json.dumps(payload).encode()
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method="POST"
+        )
+        urllib.request.urlopen(req).read()
+
+    def query(port, body: bytes):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/ing/query",
+            data=body,
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def load_initial(port):
+        """Warm index via the roaring fast path: per-shard payloads,
+        like the reference's pilosa-import client."""
+        post(port, "/index/ing", {})
+        for fld, n_rows in (("cab", 64), ("pc", 6)):
+            post(port, f"/index/ing/field/{fld}", {})
+            rows = rng.integers(0, n_rows, n).astype(np.uint64)
+            for sh in range(shards):
+                lo = sh * SHARD_WIDTH
+                pos = rows[lo : lo + SHARD_WIDTH] * np.uint64(
+                    SHARD_WIDTH
+                ) + np.arange(SHARD_WIDTH, dtype=np.uint64)
+                bm = Bitmap()
+                bm.add_many(pos)
+                post(
+                    port,
+                    f"/index/ing/field/{fld}/import-roaring/{sh}",
+                    serialize(bm),
+                )
+
+    def read_phase(port, seconds: float, readers: int, writers: int):
+        """(read_p95_ms, read_qps, bits_written, import_posts) over a
+        timed phase with concurrent reader/writer client threads."""
+        import http.client
+
+        stop = threading.Event()
+        lat_lock = threading.Lock()
+        lats: list[float] = []
+        wrote = [0, 0]  # bits, posts
+        errors: list = []
+
+        def reader(k: int):
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            i = k  # stagger so clients don't lockstep on one shape
+            try:
+                while not stop.is_set():
+                    body = read_mix[i % len(read_mix)]
+                    i += 1
+                    t0 = time.perf_counter()
+                    conn.request("POST", "/index/ing/query", body)
+                    resp = conn.getresponse()
+                    out = resp.read()
+                    if resp.status != 200:
+                        raise RuntimeError(f"read {resp.status}: {out[:120]!r}")
+                    with lat_lock:
+                        lats.append(time.perf_counter() - t0)
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+            finally:
+                conn.close()
+
+        batch = 5_000
+
+        def writer(k: int):
+            # streaming-ingest shape: events land in a handful of row
+            # buckets (NOT sprayed across hundreds of rows — that would
+            # measure the read path's dirty-row repack, not write
+            # interference)
+            conn = http.client.HTTPConnection("127.0.0.1", port)
+            wrng = np.random.default_rng(800 + k)
+            try:
+                while not stop.is_set():
+                    rows = wrng.integers(64, 64 + 8, batch)
+                    cols = wrng.integers(0, n, batch)
+                    payload = json.dumps({
+                        "rowIDs": rows.tolist(),
+                        "columnIDs": cols.tolist(),
+                    }).encode()
+                    conn.request(
+                        "POST", "/index/ing/field/cab/import", payload,
+                        headers={"Content-Type": "application/json"},
+                    )
+                    resp = conn.getresponse()
+                    body = resp.read()
+                    if resp.status == 429:
+                        # compaction-debt backpressure: honor it — the
+                        # retry IS the protocol (docs/durability.md)
+                        time.sleep(0.05)
+                        continue
+                    if resp.status != 200:
+                        raise RuntimeError(
+                            f"import {resp.status}: {body[:120]!r}"
+                        )
+                    with lat_lock:
+                        wrote[0] += batch
+                        wrote[1] += 1
+            except Exception as exc:  # noqa: BLE001 — re-raised below
+                errors.append(exc)
+            finally:
+                conn.close()
+
+        ts = [
+            threading.Thread(target=reader, args=(k,), daemon=True)
+            for k in range(readers)
+        ] + [
+            threading.Thread(target=writer, args=(k,), daemon=True)
+            for k in range(writers)
+        ]
+        t0 = time.perf_counter()
+        for t in ts:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in ts:
+            t.join(timeout=30)
+        dt = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        if not lats:
+            raise RuntimeError("read phase produced no samples")
+        lats.sort()
+        p95 = lats[min(len(lats) - 1, int(0.95 * len(lats)))] * 1e3
+        return p95, len(lats) / dt, wrote[0], wrote[1]
+
+    failed = False
+    port = free_ports(1)[0]
+    srv = spawn_server(port)
+    try:
+        load_initial(port)
+        for b in read_mix:
+            query(port, b)  # warm the plan caches
+        # reader count stays below core saturation: past it a writer
+        # stretches read latency by CPU arithmetic alone and the gate
+        # measures the box, not write-path interference
+        readers = int(os.environ.get(
+            "PILOSA_BENCH_INGEST_READERS",
+            str(max(1, (os.cpu_count() or 2) - 1)),
+        ))
+        base_p95, base_qps, _, _ = read_phase(
+            port, phase_s, readers=readers, writers=0
+        )
+        mix_p95, mix_qps, bits, posts = read_phase(
+            port, phase_s, readers=readers, writers=1
+        )
+        if mix_p95 / max(base_p95, 1e-9) > guard:
+            # gates compare phases measured ~10s apart on shared CPU:
+            # confirm back-to-back before declaring a violation (same
+            # drift discipline as the config8 sweep)
+            base2, _, _, _ = read_phase(port, phase_s, readers=readers,
+                                        writers=0)
+            mix2, mq2, b2, p2 = read_phase(port, phase_s,
+                                           readers=readers, writers=1)
+            if mix2 / max(base2, 1e-9) < mix_p95 / max(base_p95, 1e-9):
+                base_p95, mix_p95, mix_qps = base2, mix2, mq2
+                bits, posts = bits + b2, posts + p2
+                phase_s *= 2  # bits accumulated over both write phases
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/vars"
+        ) as r:
+            dv = json.loads(r.read())
+        compactions = sum(
+            int(v)
+            for k, v in dv.get("counters", {}).items()
+            if k.startswith("compactions_total")
+        )
+        ratio = mix_p95 / max(base_p95, 1e-9)
+        line(
+            "ingest_mixed_read_p95_ratio",
+            ratio,
+            "ratio",
+            1.0,
+            extra={
+                "read_only_p95_ms": round(base_p95, 3),
+                "mixed_p95_ms": round(mix_p95, 3),
+                "read_only_qps": round(base_qps, 1),
+                "mixed_read_qps": round(mix_qps, 1),
+                "guard": guard,
+                "durability": dv.get("durability", {}),
+            },
+        )
+        line(
+            "ingest_sustained_msetbits_per_s",
+            bits / phase_s / 1e6,
+            "Mbit/s",
+            1.0,
+            extra={
+                "import_posts": posts,
+                "compactions_during_run": compactions,
+            },
+        )
+        if compactions < 1:
+            # a mixed row whose compactor never ran proves nothing
+            # about the write path under pressure
+            failed = True
+            line("ingest_compactor_never_ran", 0.0, "error", 0.0)
+        if ratio > guard:
+            failed = True
+            line("ingest_read_p95_gate_violated", ratio, "error", ratio)
+    finally:
+        stop_server(srv)
+
+    # ---- restart-to-serving over the data the run just persisted
+    port2 = free_ports(1)[0]
+    t0 = time.perf_counter()
+    srv2 = spawn_server(port2, {"PILOSA_TPU_HOLDER_LOAD_WORKERS": "8"})
+    try:
+        query(port2, read_body)  # first served query = serving
+        restart_s = time.perf_counter() - t0
+    finally:
+        stop_server(srv2)
+
+    # in-process holder open isolates the STORAGE half (snapshot
+    # deserialize + checked ops-log replay), serial vs parallel
+    from pilosa_tpu.core import Holder
+
+    def holder_open_s(workers: int) -> tuple[float, int]:
+        t0 = time.perf_counter()
+        h = Holder(data_dir, load_workers=workers)
+        h.open()
+        dt = time.perf_counter() - t0
+        frags = sum(
+            len(v.fragments)
+            for idx in h.indexes.values()
+            for f in idx.fields.values()
+            for v in f.views.values()
+        )
+        h.close()
+        return dt, frags
+
+    serial_s, n_frags = holder_open_s(1)
+    parallel_s, _ = holder_open_s(8)
+    line(
+        "restart_to_serving_s",
+        restart_s,
+        "s",
+        1.0,
+        extra={
+            "fragments": n_frags,
+            "holder_open_serial_s": round(serial_s, 3),
+            "holder_open_parallel_s": round(parallel_s, 3),
+            "load_workers": 8,
+        },
+    )
+    import shutil
+
+    shutil.rmtree(data_dir, ignore_errors=True)
+    if failed:
+        sys.exit(1)
+
+
 def config9_degraded_cluster():
     """ISSUE 5: degraded-cluster read serving — 3-node in-process
     cluster (replica_n=2) with the peer the coordinator's routing
@@ -1401,6 +1755,7 @@ CONFIGS = {
     "7": config7_cluster_read,
     "8": config8_concurrency_sweep,
     "9": config9_degraded_cluster,
+    "ingest": config_ingest,
     "multichip": config_multichip,
 }
 

@@ -20,6 +20,8 @@ import os
 import threading
 import time
 
+from pilosa_tpu.utils import durable
+
 ATTR_BLOCK_SIZE = 100
 
 # journal entries that trigger a compaction (snapshot rewrite + truncate)
@@ -81,8 +83,7 @@ class AttrStore:
                     # would weld the next record onto the partial line,
                     # silently discarding everything from the tear on at
                     # the following open
-                    with open(jp, "r+b") as f:
-                        f.truncate(good)
+                    durable.truncate_file(jp, good)
 
     def close(self) -> None:
         pass
@@ -113,31 +114,34 @@ class AttrStore:
             self._compact()
             return
         os.makedirs(os.path.dirname(jp), exist_ok=True)
-        with open(jp, "a") as f:
-            f.write(json.dumps(delta) + "\n")
+        # WAL-mode append (docs/durability.md): fsynced inline in
+        # `always`, group-fsynced at the API's ack barrier in `batch`
+        durable.append_wal(jp, (json.dumps(delta) + "\n").encode())
 
     def _compact(self) -> None:
         self._prune_tombstones()
         self._persist()
         jp = self._journal_path()
-        if jp:
-            open(jp, "w").close()
+        if jp and os.path.exists(jp):
+            # reset AFTER the snapshot replace is durable: a crash
+            # between the two just replays the journal over the new
+            # snapshot (idempotent LWW apply)
+            durable.truncate_file(jp, 0)
         self._journal_ops = 0
 
     def _persist(self) -> None:
         if self.path is None:
             return
         os.makedirs(os.path.dirname(self.path), exist_ok=True)
-        tmp = self.path + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(
+        durable.atomic_write_file(
+            self.path,
+            json.dumps(
                 {
                     "_v": 2,
                     "cells": {str(k): v for k, v in self._cells.items()},
-                },
-                f,
-            )
-        os.replace(tmp, self.path)
+                }
+            ),
+        )
 
     def set_attrs(self, id_: int, attrs: dict, ts: float | None = None) -> None:
         """Merge attrs for an ID; null values delete keys — kept as

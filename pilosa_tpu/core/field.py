@@ -28,6 +28,7 @@ from pilosa_tpu.core.cache import DEFAULT_CACHE_SIZE
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.core.view import VIEW_BSI, VIEW_STANDARD, View
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import durable
 
 
 def _shard_slices(cols: np.ndarray):
@@ -102,6 +103,10 @@ class Field:
         self.options = options
         self.views: dict[str, View] = {}
         self._create_lock = threading.Lock()
+        self._meta_lock = threading.Lock()
+        # background compaction queue, inherited by views/fragments
+        # created under this field (injected by the holder chain)
+        self.compactor = None
         # row attributes (reference: field.go rowAttrStore) and row-key
         # translation (reference: translate.go)
         self.row_attrs = AttrStore(
@@ -121,17 +126,37 @@ class Field:
     def save_meta(self) -> None:
         if self.path is None:
             return
-        os.makedirs(self.path, exist_ok=True)
-        meta = {"options": asdict(self.options), "bit_depth": self._bit_depth}
-        with open(os.path.join(self.path, ".meta.json"), "w") as f:
-            json.dump(meta, f)
+        # serialized: concurrent per-shard import slices can grow
+        # bit_depth simultaneously, and two atomic writes to the same
+        # path would race on the shared tmp name (one renames it away,
+        # the other's rename fails)
+        with self._meta_lock:
+            os.makedirs(self.path, exist_ok=True)
+            meta = {
+                "options": asdict(self.options),
+                "bit_depth": self._bit_depth,
+            }
+            durable.atomic_write_file(
+                os.path.join(self.path, ".meta.json"), json.dumps(meta)
+            )
 
     @classmethod
-    def load(cls, index: str, name: str, path: str) -> "Field":
+    def load(
+        cls, index: str, name: str, path: str, compactor=None, pool=None
+    ) -> "Field":
+        """Load a field's views and fragments from disk. With ``pool``
+        (a ThreadPoolExecutor lent by Holder.open), fragment opens —
+        the snapshot deserialize + ops-log replay that dominates cold
+        start — are submitted concurrently; ``pool.futures`` collects
+        them for the holder-level join. create_fragment_if_not_exists
+        double-checks under a per-shard lock, so concurrent opens of
+        different shards genuinely overlap (a view-wide lock here would
+        serialize the whole load)."""
         with open(os.path.join(path, ".meta.json")) as f:
             meta = json.load(f)
         f_obj = cls(index, name, path, FieldOptions(**meta["options"]))
         f_obj._bit_depth = meta.get("bit_depth", f_obj._bit_depth)
+        f_obj.compactor = compactor
         views_dir = os.path.join(path, "views")
         if os.path.isdir(views_dir):
             for view_name in sorted(os.listdir(views_dir)):
@@ -140,7 +165,15 @@ class Field:
                 if os.path.isdir(frags_dir):
                     for shard_name in sorted(os.listdir(frags_dir)):
                         if shard_name.isdigit() and not shard_name.endswith(".snapshotting"):
-                            view.create_fragment_if_not_exists(int(shard_name))
+                            if pool is not None:
+                                pool.futures.append(
+                                    pool.submit(
+                                        view.create_fragment_if_not_exists,
+                                        int(shard_name),
+                                    )
+                                )
+                            else:
+                                view.create_fragment_if_not_exists(int(shard_name))
         return f_obj
 
     # ------------------------------------------------------------- views
@@ -168,6 +201,7 @@ class Field:
                 cache_type,
                 self.options.cache_size,
             )
+            v.compactor = self.compactor
             self.views[name] = v
         return v
 

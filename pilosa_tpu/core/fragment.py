@@ -41,8 +41,15 @@ _FRAGMENT_UIDS = itertools.count(1)
 from pilosa_tpu import roaring
 from pilosa_tpu.core.cache import NopCache, make_cache
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.utils import durable
+from pilosa_tpu.utils.log import Logger
 
-MAX_OP_N = 2000  # ops-log length that triggers a snapshot (reference default)
+_LOG = Logger()  # stderr sink; recovery events must be loud
+
+# ops-log length that triggers a snapshot fold (reference default 2000);
+# env-overridable so benches/chaos runs can keep the background
+# compactor hot without minutes of ingest per fold
+MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "2000"))
 ROWS_PER_BLOCK = 100  # anti-entropy block granularity (reference: HashBlockSize)
 MIN_PADDED_ROWS = 8  # sublane tile for int32
 
@@ -76,6 +83,27 @@ class Fragment:
         self.max_op_n = MAX_OP_N
         self._lock = threading.RLock()
         self._opened = False  # gates ops-log appends (see _append_op)
+        # background compaction hand-off (core/compact.py), injected by
+        # the owning View: when set, an over-threshold ops log queues a
+        # compaction instead of paying the full snapshot inside the
+        # fragment lock on the write path; None = the pre-PR-8 inline
+        # snapshot (standalone fragments, tests)
+        self._compactor = None
+        # snapshot-file generation: bumped (under _lock) every time the
+        # file at ``path`` is rewritten. compact() records it before
+        # releasing the lock to serialize and aborts its commit if an
+        # inline snapshot() (bulk import, anti-entropy merge) rewrote
+        # the file meanwhile — welding the NEW file's bytes past a stale
+        # base offset onto the clone would commit garbage over it
+        self._snap_gen = 0
+        # set by drop(): the fragment was relinquished (resize handoff)
+        # and its file deleted — late appends and queued compactions
+        # must not resurrect it
+        self._dropped = False
+        # what the last open() recovered: {"tornBytes", "corrupt",
+        # "corruptOffset", "quarantined"} — tests and /debug assert on
+        # this instead of scraping the log
+        self.last_recovery: dict | None = None
 
         self._np_matrix: np.ndarray | None = None
         self._dirty_rows: set[int] = set()
@@ -106,20 +134,79 @@ class Fragment:
 
     # ----------------------------------------------------------- lifecycle
     def open(self) -> None:
-        """Load snapshot + replay ops log (reference: fragment.Open)."""
+        """Load snapshot + replay ops log (reference: fragment.Open),
+        repairing whatever a crash left behind (docs/durability.md):
+
+        - a stale ``.snapshotting`` tmp is discarded — it was never
+          renamed in, so the old snapshot at ``path`` is authoritative;
+        - a snapshot with a bad roaring header is quarantined to
+          ``<path>.corrupt`` and the fragment reopens empty (loudly) —
+          the ``.snapshotting``-era recovery rule: never adopt bytes the
+          atomic-replace protocol didn't commit;
+        - the ops log replays through ``replay_ops_checked``: a torn
+          tail truncates cleanly, a checksum mismatch (in-place
+          corruption) is reported with fragment path + byte offset and
+          everything from the bad record on is truncated — appending
+          after a damaged tail would weld the next op onto it."""
         with self._lock:
-            if self.path and os.path.exists(self.path):
-                with open(self.path, "rb") as f:
-                    data = f.read()
-                if data:
-                    self.bitmap, consumed = roaring.deserialize(data)
-                    self.op_n = roaring.replay_ops(self.bitmap, data[consumed:])
             if self.path:
                 os.makedirs(os.path.dirname(self.path), exist_ok=True)
+                self._recover()
                 if not os.path.exists(self.path):
                     self._write_snapshot()
             self._opened = True
             self._mark_all_dirty()
+
+    def _recover(self) -> None:
+        # two tmp names: ".snapshotting" (inline snapshot) and
+        # ".compacting" (background fold) — distinct so an inline
+        # snapshot landing while a compaction serializes off-lock can
+        # never write through the compactor's still-open tmp fd
+        for suffix in (".snapshotting", ".compacting"):
+            stale_tmp = self.path + suffix
+            if os.path.exists(stale_tmp):
+                _LOG.log(
+                    f"fragment {self.path}: discarding stale {suffix} tmp "
+                    "(crash mid-snapshot; previous snapshot is authoritative)"
+                )
+                os.remove(stale_tmp)
+        if not os.path.exists(self.path):
+            return
+        with open(self.path, "rb") as f:
+            data = f.read()
+        if not data:
+            return
+        rec = {"tornBytes": 0, "corrupt": False, "corruptOffset": -1,
+               "quarantined": False}
+        self.last_recovery = rec
+        try:
+            self.bitmap, consumed = roaring.deserialize(data)
+        except ValueError as e:
+            quarantine = self.path + ".corrupt"
+            durable.replace_durable(self.path, quarantine)
+            rec["quarantined"] = True
+            _LOG.log(
+                f"fragment {self.path}: snapshot rejected ({e}); "
+                f"quarantined to {quarantine}, reopening empty"
+            )
+            self.bitmap = roaring.Bitmap()
+            self.op_n = 0
+            return
+        res = roaring.replay_ops_checked(self.bitmap, data[consumed:])
+        self.op_n = res.n_ops
+        good_end = consumed + res.good_bytes
+        if res.corrupt:
+            rec["corrupt"] = True
+            rec["corruptOffset"] = consumed + res.corrupt_offset
+            _LOG.log(
+                f"fragment {self.path}: ops-log checksum mismatch at "
+                f"byte offset {consumed + res.corrupt_offset} — "
+                f"truncating the untrusted tail ({len(data) - good_end} "
+                "bytes)"
+            )
+        if good_end < len(data):
+            rec["tornBytes"] = len(data) - good_end
+            durable.truncate_file(self.path, good_end)
 
     def close(self) -> None:
         pass  # no retained file handle (see _append_op)
@@ -133,33 +220,126 @@ class Fragment:
         is batched) is microseconds against the numpy work, and leaves
         fds in use only while a write is in flight. Gated on open():
         mutating a never-opened pathed fragment must stay in-memory-only
-        (appending to a file with no snapshot header would corrupt it)."""
-        if self.path is None or not self._opened:
+        (appending to a file with no snapshot header would corrupt it).
+
+        Durability: the append goes through ``durable.append_wal`` —
+        fsynced per the WAL mode (``always`` inline, ``batch`` at the
+        API's ack barrier, ``off`` never). An over-threshold ops log
+        queues a BACKGROUND compaction when a compactor is attached;
+        the inline snapshot (which pays serialize+fsync+rename inside
+        the fragment lock, stalling the write path) remains only for
+        standalone fragments."""
+        if self.path is None or not self._opened or self._dropped:
             return
-        with open(self.path, "ab") as f:
-            f.write(roaring.append_op(opcode, values))
+        durable.append_wal(self.path, roaring.append_op(opcode, values))
         self.op_n += 1
         if self.op_n > self.max_op_n:
-            self.snapshot()
+            if self._compactor is not None:
+                self._compactor.request(self, reason="threshold")
+            else:
+                self.snapshot()
 
     def snapshot(self) -> None:
         """Durable full rewrite; truncates the ops log (reference:
-        fragment.snapshot)."""
+        fragment.snapshot). Synchronous — holds the fragment lock for
+        the whole serialize; the hot write path uses the background
+        compactor instead (see _append_op)."""
         with self._lock:
-            if self.path is None:
+            if self.path is None or self._dropped:
+                # dropped: a stale reference's late bulk write (import,
+                # anti-entropy merge) must not recreate the relinquished
+                # shard's file any more than a queued compaction may
                 self.op_n = 0
                 return
             self._write_snapshot()
             self.op_n = 0
 
     def _write_snapshot(self) -> None:
-        tmp = self.path + ".snapshotting"
-        with open(tmp, "wb") as f:
-            # in-place compaction is safe here: snapshot() holds _lock
-            f.write(roaring.serialize(self.bitmap, compact_in_place=True))
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self.path)
+        # in-place compaction is safe here: callers hold _lock
+        data = roaring.serialize(self.bitmap, compact_in_place=True)
+        durable.atomic_write_file(
+            self.path, data, tmp_suffix=".snapshotting", op="snapshot-write"
+        )
+        self._snap_gen += 1
+
+    def drop(self) -> None:
+        """Mark the fragment relinquished and delete its file (cluster
+        resize handoff) — under the fragment lock, so an in-flight
+        ``compact()`` commit cannot land its tmp over the freshly
+        deleted path and resurrect the shard's data on disk; a
+        compaction still queued for this fragment becomes a no-op."""
+        with self._lock:
+            self._dropped = True
+            if self.path and os.path.exists(self.path):
+                os.remove(self.path)
+
+    def compact(self) -> bool:
+        """Fold the ops log into a fresh snapshot WITHOUT stalling
+        writers: the bulk of the work (serializing the bitmap, writing +
+        fsyncing the new snapshot) runs outside the fragment lock, so a
+        concurrent ``Set()`` only ever waits for the two short locked
+        phases (a shallow container-dict clone; the tail carry + rename).
+
+        Protocol — crash-safe at every point (the old snapshot file
+        stays valid until the atomic replace commits):
+
+        1. under the lock: shallow-clone the bitmap (containers are
+           copy-on-write — every mutator replaces, never edits, a
+           container, so sharing them with a live writer is safe),
+           record the current file length L and op count;
+        2. off the lock: serialize the clone and write it to the
+           ``.compacting`` tmp (NOT ``.snapshotting`` — an inline
+           snapshot() racing this phase must not rename our half-written
+           tmp into place or interleave with our open fd), fsynced;
+        3. under the lock: re-check the snapshot generation — an inline
+           ``snapshot()`` (bulk import adopt, anti-entropy merge) that
+           rewrote the file while we serialized already folded every op,
+           and our clone is stale against it, so the commit aborts —
+           then copy the ops appended since the clone (the old file's
+           bytes past L) onto the tmp, fsync, atomically replace +
+           dir-fsync, and subtract the folded ops from op_n.
+
+        Returns True if a snapshot was committed, False on an abort
+        (dropped fragment, concurrent inline snapshot won) — the
+        compactor counts only real folds.
+        """
+        with self._lock:
+            if self._dropped:
+                return False
+            if self.path is None:
+                self.op_n = 0
+                return False
+            if not os.path.exists(self.path):
+                # never snapshotted (path created mid-teardown?): the
+                # inline write is the only correct form
+                self._write_snapshot()
+                self.op_n = 0
+                return True
+            clone = roaring.Bitmap()
+            clone._containers = dict(self.bitmap._containers)
+            base_len = os.path.getsize(self.path)
+            ops_at_clone = self.op_n
+            gen_at_clone = self._snap_gen
+        data = roaring.serialize(clone)  # NOT in place: containers shared
+        tmp = self.path + ".compacting"
+        durable.write_new_file(tmp, data, op="snapshot-write")
+        with self._lock:
+            if self._dropped or self._snap_gen != gen_at_clone:
+                # the file we cloned against is gone (drop) or was
+                # rewritten by an inline snapshot that folded everything
+                # — bytes past base_len are snapshot payload, not ops;
+                # committing would clobber the newer state
+                os.remove(tmp)
+                return False
+            with open(self.path, "rb") as f:
+                f.seek(base_len)
+                tail = f.read()  # ops appended while we serialized
+            if tail:
+                durable.append_file(tmp, tail, op="snapshot-write")
+            durable.replace_durable(tmp, self.path)
+            self._snap_gen += 1
+            self.op_n -= ops_at_clone
+            return True
 
     # ------------------------------------------------------------- rows
     def n_rows(self) -> int:

@@ -6,6 +6,15 @@ index/field/view/fragment). Directory layout:
     <data-dir>/<index>/.meta.json
     <data-dir>/<index>/<field>/.meta.json
     <data-dir>/<index>/<field>/views/<view>/fragments/<shard>
+
+Durability (docs/durability.md): the holder owns the node's ONE
+background compaction queue (core/compact.py) — every fragment created
+under it inherits the compactor, so an over-threshold ops log folds off
+the write path. ``open()`` loads fragments through a bounded thread
+pool: cold start is dominated by snapshot deserialize + ops-log replay,
+which parallelize cleanly (per-fragment state, no shared mutation), and
+the device upload stays lazy (first query per stack), so
+restart-to-serving is bounded by the slowest fragment, not the sum.
 """
 
 from __future__ import annotations
@@ -13,28 +22,65 @@ from __future__ import annotations
 import os
 import threading
 import shutil
+from concurrent.futures import ThreadPoolExecutor
 
+from pilosa_tpu.core.compact import Compactor
 from pilosa_tpu.core.index import Index, IndexOptions
 
 
+class _LoadPool(ThreadPoolExecutor):
+    """ThreadPoolExecutor plus a futures list the field loaders append
+    to, so Holder.open can join (and surface the first error from)
+    every concurrent fragment open."""
+
+    def __init__(self, workers: int):
+        super().__init__(max_workers=workers, thread_name_prefix="holder-load")
+        self.futures: list = []
+
+
 class Holder:
-    def __init__(self, path: str | None = None):
+    def __init__(
+        self,
+        path: str | None = None,
+        compaction_workers: int = 1,
+        load_workers: int = 8,
+        stats=None,
+    ):
         self.path = path
         self.indexes: dict[str, Index] = {}
         self._create_lock = threading.Lock()
+        # parallel cold-start fragment loading; <=1 loads serially
+        self.load_workers = load_workers
+        self.compactor = Compactor(workers=compaction_workers, stats=stats)
 
     def open(self) -> None:
         if self.path is None:
             return
         os.makedirs(self.path, exist_ok=True)
-        for entry in sorted(os.listdir(self.path)):
-            index_path = os.path.join(self.path, entry)
-            if os.path.isdir(index_path) and os.path.exists(
-                os.path.join(index_path, ".meta.json")
-            ):
-                self.indexes[entry] = Index.load(entry, index_path)
+        pool = _LoadPool(self.load_workers) if self.load_workers > 1 else None
+        try:
+            for entry in sorted(os.listdir(self.path)):
+                index_path = os.path.join(self.path, entry)
+                if os.path.isdir(index_path) and os.path.exists(
+                    os.path.join(index_path, ".meta.json")
+                ):
+                    self.indexes[entry] = Index.load(
+                        entry, index_path, compactor=self.compactor, pool=pool
+                    )
+            if pool is not None:
+                # join every concurrent fragment open; re-raise the first
+                # failure (a quarantined snapshot logs and recovers, so
+                # what reaches here is a real I/O error worth dying on)
+                for fut in pool.futures:
+                    fut.result()
+        finally:
+            if pool is not None:
+                pool.shutdown(wait=True)
 
     def close(self) -> None:
+        # drain queued compactions first: shutdown must not abandon an
+        # over-threshold ops log a queued fold was about to shrink
+        self.compactor.close(drain=True)
         for idx in self.indexes.values():
             idx.close()
 
@@ -63,6 +109,7 @@ class Holder:
             return existing
         index_path = os.path.join(self.path, name) if self.path else None
         idx = Index(name, index_path, options)
+        idx.compactor = self.compactor
         idx.save_meta()
         self.indexes[name] = idx
         return idx

@@ -19,6 +19,7 @@ from pilosa_tpu.core.attrstore import AttrStore
 from pilosa_tpu.core.field import FIELD_SET, VIEW_STANDARD, Field, FieldOptions
 from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import durable
 
 EXISTENCE_FIELD = "_exists"
 
@@ -36,6 +37,8 @@ class Index:
         self.options = options or IndexOptions()
         self.fields: dict[str, Field] = {}
         self._create_lock = threading.Lock()
+        # background compaction queue, inherited by fields created here
+        self.compactor = None
         # column attributes (reference: index.go columnAttrStore) and
         # column-key translation (reference: translate.go)
         self.column_attrs = AttrStore(
@@ -52,20 +55,27 @@ class Index:
         if self.path is None:
             return
         os.makedirs(self.path, exist_ok=True)
-        with open(os.path.join(self.path, ".meta.json"), "w") as f:
-            json.dump({"options": asdict(self.options)}, f)
+        durable.atomic_write_file(
+            os.path.join(self.path, ".meta.json"),
+            json.dumps({"options": asdict(self.options)}),
+        )
 
     @classmethod
-    def load(cls, name: str, path: str) -> "Index":
+    def load(
+        cls, name: str, path: str, compactor=None, pool=None
+    ) -> "Index":
         with open(os.path.join(path, ".meta.json")) as f:
             meta = json.load(f)
         idx = cls(name, path, IndexOptions(**meta["options"]))
+        idx.compactor = compactor
         for entry in sorted(os.listdir(path)):
             field_path = os.path.join(path, entry)
             if os.path.isdir(field_path) and os.path.exists(
                 os.path.join(field_path, ".meta.json")
             ):
-                idx.fields[entry] = Field.load(name, entry, field_path)
+                idx.fields[entry] = Field.load(
+                    name, entry, field_path, compactor=compactor, pool=pool
+                )
         return idx
 
     # ------------------------------------------------------------ fields
@@ -94,6 +104,7 @@ class Index:
             return existing
         field_path = os.path.join(self.path, name) if self.path else None
         f = Field(self.name, name, field_path, options or FieldOptions())
+        f.compactor = self.compactor
         f.save_meta()
         self.fields[name] = f
         return f

@@ -16,6 +16,11 @@ import json
 import os
 import threading
 
+from pilosa_tpu.utils import durable
+from pilosa_tpu.utils.log import Logger
+
+_LOG = Logger()  # stderr sink; recovery events must be loud
+
 
 class TranslateStore:
     def __init__(self, path: str | None = None):
@@ -46,20 +51,39 @@ class TranslateStore:
                 return
             os.makedirs(os.path.dirname(self.path), exist_ok=True)
             if os.path.exists(self.path):
-                with open(self.path) as f:
-                    for line in f:
-                        line = line.strip()
-                        if not line:
-                            continue
+                with open(self.path, "rb") as f:
+                    raw = f.read()
+                good = 0  # byte offset of the last complete good line
+                for line_b in raw.splitlines(keepends=True):
+                    if not line_b.endswith(b"\n"):
+                        break  # torn tail: the line never completed
+                    line = line_b.strip()
+                    if line:
                         try:
                             entry = json.loads(line)
-                        except json.JSONDecodeError:
-                            break  # torn tail write
+                        except (json.JSONDecodeError, UnicodeDecodeError):
+                            break  # torn/corrupt record
                         # replay with displacement: the log may record a
                         # fork reconciliation (winning entry appended
                         # after the stale one) — last write wins cleanly
                         self._apply_displacing(entry["k"], entry["id"], [])
-            self._file = open(self.path, "a")
+                    good += len(line_b)
+                if good < len(raw):
+                    # truncate the untrusted tail BEFORE reopening for
+                    # append: a new record welded onto a partial line
+                    # would make one unparseable line, and the NEXT
+                    # reopen would silently drop every acknowledged
+                    # binding appended after the weld
+                    _LOG.log(
+                        f"translate log {self.path}: discarding "
+                        f"{len(raw) - good} torn/corrupt tail byte(s) "
+                        f"at offset {good}"
+                    )
+                    durable.truncate_file(self.path, good)
+            # retained append handle (allocation rate makes open-per-
+            # write measurable here); durability bookkeeping happens at
+            # each flushed write via durable.wal_written
+            self._file = durable.open_wal(self.path, "a")
 
     def close(self) -> None:
         with self._lock:
@@ -104,6 +128,7 @@ class TranslateStore:
             if self._file:
                 self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
                 self._file.flush()
+                durable.wal_written(self.path, self._file.fileno())
             return id_
 
     def translate_keys(self, keys: list[str], create: bool = True) -> list[int | None]:
@@ -233,6 +258,7 @@ class TranslateStore:
                     self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
             if self._file:
                 self._file.flush()
+                durable.wal_written(self.path, self._file.fileno())
         return dropped
 
     def _apply_displacing(

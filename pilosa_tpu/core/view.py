@@ -37,6 +37,15 @@ class View:
         self.cache_size = cache_size
         self.fragments: dict[int, Fragment] = {}
         self._create_lock = threading.Lock()
+        # per-shard creation locks: fragment OPEN (snapshot deserialize +
+        # ops-log replay, the cold-start cost) must run outside any
+        # view-wide lock or holder-load-workers degenerates to a serial
+        # load; _create_lock only guards this dict and self.fragments
+        self._open_locks: dict[int, threading.Lock] = {}
+        # background compaction queue (core/compact.py) injected by the
+        # holder chain; every fragment created here inherits it so an
+        # over-threshold ops log folds off the write path
+        self.compactor = None
         # mutation stamp covering EVERY fragment of this view (bumped on
         # any fragment mutation or creation): lets the query compiler's
         # stack cache validate a whole shard list in O(1) instead of
@@ -52,33 +61,44 @@ class View:
         return self.fragments.get(shard)
 
     def create_fragment_if_not_exists(self, shard: int) -> Fragment:
-        # double-checked under a lock: two concurrent writers racing this
-        # would otherwise build two Fragment objects over the same file
-        # (clashing snapshot tmp files, lost updates)
+        # double-checked under a PER-SHARD lock: two concurrent writers
+        # racing the same shard would otherwise build two Fragment
+        # objects over the same file (clashing snapshot tmp files, lost
+        # updates) — while opens of DIFFERENT shards (the parallel
+        # holder cold start) proceed concurrently. The fragment is
+        # published only after open() completes, so readers never see a
+        # half-loaded bitmap.
         frag = self.fragments.get(shard)
         if frag is not None:
             return frag
         with self._create_lock:
             frag = self.fragments.get(shard)
-            if frag is None:
-                frag_path = (
-                    os.path.join(self.path, "fragments", str(shard))
-                    if self.path
-                    else None
-                )
-                frag = Fragment(
-                    frag_path,
-                    self.index,
-                    self.field,
-                    self.name,
-                    shard,
-                    cache_type=self.cache_type,
-                    cache_size=self.cache_size,
-                )
-                frag.open()
-                frag._on_mutate = self._bump_version
-                self.fragments[shard] = frag
-                self._bump_version()
+            if frag is not None:
+                return frag
+            shard_lock = self._open_locks.setdefault(shard, threading.Lock())
+        with shard_lock:
+            frag = self.fragments.get(shard)
+            if frag is not None:
+                return frag
+            frag_path = (
+                os.path.join(self.path, "fragments", str(shard))
+                if self.path
+                else None
+            )
+            frag = Fragment(
+                frag_path,
+                self.index,
+                self.field,
+                self.name,
+                shard,
+                cache_type=self.cache_type,
+                cache_size=self.cache_size,
+            )
+            frag._compactor = self.compactor
+            frag.open()
+            frag._on_mutate = self._bump_version
+            self.fragments[shard] = frag
+            self._bump_version()
         return frag
 
     def available_shards(self) -> set[int]:
@@ -94,8 +114,10 @@ class View:
             return False
         self._bump_version()
         frag.close()
-        if frag.path and os.path.exists(frag.path):
-            os.remove(frag.path)
+        # drop() marks the fragment relinquished under its own lock —
+        # a compaction already queued (or mid-flight) for it must not
+        # rewrite the file and resurrect the shard's data on disk
+        frag.drop()
         return True
 
     def close(self) -> None:

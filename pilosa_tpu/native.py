@@ -38,7 +38,11 @@ def _build() -> bool:
             capture_output=True,
             timeout=120,
         )
-        os.replace(_LIB + ".tmp", _LIB)
+        from pilosa_tpu.utils import durable
+
+        # the compiler produced the tmp; commit it with the sanctioned
+        # rename (durable=False: a lost build artifact just rebuilds)
+        durable.replace_durable(_LIB + ".tmp", _LIB, durable=False)
         return True
     except (subprocess.SubprocessError, OSError, PermissionError):
         return False

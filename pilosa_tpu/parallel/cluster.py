@@ -54,7 +54,7 @@ from pilosa_tpu.encoding import frame
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.roaring import serialize
 from pilosa_tpu.shardwidth import SHARD_WIDTH
-from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils import durable, tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 HEARTBEAT_INTERVAL = 2.0
@@ -1169,15 +1169,23 @@ class Cluster:
         api = self.server.api
         api.check_write_limit(api.count_query_writes(calls), "query")
         results = []
+        wrote = False
         for call in calls:
             # classify on the innermost call: Options(Set(...)) — however
             # deeply wrapped — must take the write path (replica
             # fan-out), not the read scatter
             inner = unwrap_options(call)
             if inner.name in WRITE_CALLS:
+                wrote = True
                 results.append(self._route_write(index, inner))
             else:
                 results.append(self._route_read(index, call, shards))
+        if wrote:
+            # the coordinator-local write legs (and any translate-key
+            # allocations the routing did) dirtied WALs on THIS node:
+            # group-fsync them before the acknowledgement leaves, same
+            # contract as the single-node api.query (docs/durability.md)
+            durable.ack_barrier()
         resp = self.server.api.build_response(results)
         qctx = resilience.current_query_context()
         if qctx is not None and qctx.partial_shards:
@@ -1948,6 +1956,9 @@ class Cluster:
         else:
             store = idx.column_attrs
         store.set_attrs(int(payload["id"]), payload["attrs"], ts=payload["ts"])
+        # replica-side durability barrier: the RPC ack this write rides
+        # back on is an acknowledgement too (docs/durability.md)
+        durable.ack_barrier()
 
     # -------------------------------------------------------------- imports
     def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
@@ -2715,10 +2726,20 @@ class Cluster:
         # through the wave scheduler: concurrent remote legs from
         # different coordinators (or wave-mates) share this node's
         # device dispatch/readback waves exactly like client queries
+        calls = (
+            parse(body["query"])
+            if isinstance(body["query"], str)
+            else body["query"]
+        )
         with self._hop_query_context(handler):
             results = self.server.api.scheduler.execute(
-                body["index"], body["query"], shards=body.get("shards")
+                body["index"], calls, shards=body.get("shards")
             )
+        if self.server.api.count_query_writes(calls):
+            # replica-side durability barrier: the RPC ack a write leg
+            # rides back on IS the coordinator's acknowledgement — its
+            # ops-log appends must be on disk first (docs/durability.md)
+            durable.ack_barrier()
         # framed response: JSON control + raw packed-word blobs — a wide
         # Row() partial crosses the wire at 4 bytes/word instead of
         # base64's 5.33 plus JSON string parse (reference: internal
@@ -3114,6 +3135,11 @@ class Cluster:
             ids = self._primary_allocate(
                 body["index"], body.get("field"), store, body["keys"], create
             )
+        if create:
+            # allocations appended to the translate WAL (locally, or via
+            # the forwarded primary's apply_entries above): durable
+            # before the RPC ack leaves (docs/durability.md)
+            durable.ack_barrier()
         if proto:
             handler._proto(encoding.protoser.translate_keys_response_to_bytes(ids))
         else:
@@ -3141,6 +3167,9 @@ class Cluster:
                 f"translate apply {body['index']}/{body.get('field') or '<columns>'}: "
                 f"primary push displaced {len(dropped)} local binding(s)"
             )
+        # replicate-before-ack only holds if the replica's copy is ON
+        # DISK when the primary's push returns (docs/durability.md)
+        durable.ack_barrier()
         handler._json({"applied": True})
 
 

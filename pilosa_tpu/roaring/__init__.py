@@ -16,9 +16,11 @@ from pilosa_tpu.roaring.pack import (
 from pilosa_tpu.roaring.serialize import (
     OP_ADD,
     OP_REMOVE,
+    ReplayResult,
     append_op,
     deserialize,
     replay_ops,
+    replay_ops_checked,
     serialize,
     serialize_official,
 )
@@ -35,6 +37,8 @@ __all__ = [
     "deserialize",
     "append_op",
     "replay_ops",
+    "replay_ops_checked",
+    "ReplayResult",
     "OP_ADD",
     "OP_REMOVE",
 ]

@@ -30,15 +30,26 @@ Legacy layout (round 1, still readable):
     payloads: as above except runs prefixed by uint32 n_runs
 
 Ops log (framework-specific; appended after either snapshot, replayed on
-load — upstream's op byte layout is version-dependent and unverifiable):
-    repeated (uint8 magic=0xF1 | uint8 opcode | uint32 count |
-              count × uint64 values) — opcode 1=add, 2=remove
+load — upstream's op byte layout is version-dependent and unverifiable).
+Two record framings are readable; v2 is what gets written:
+
+    v1: uint8 magic=0xF1 | uint8 opcode | uint32 count | count × uint64
+    v2: uint8 magic=0xF2 | uint8 opcode | uint32 count | uint32 crc32 |
+        count × uint64 values
+
+v2's crc32 covers the header-sans-crc AND the value payload, so reopen
+distinguishes a torn tail (record runs past EOF — truncate, the write
+never finished) from in-place corruption (full-length record, checksum
+mismatch — report with offset, then truncate conservatively). opcode
+1=add, 2=remove either way.
 """
 
 from __future__ import annotations
 
 import io
 import struct
+import zlib
+from dataclasses import dataclass
 
 import numpy as np
 
@@ -54,13 +65,15 @@ VERSION = 1  # this framework's round-1 layout (read-compat only)
 OFFICIAL_COOKIE = 12347  # run containers present (packed count, run bitset)
 OFFICIAL_COOKIE_NO_RUNS = 12346  # no runs; separate uint32 count, offsets
 _OFFICIAL_NO_OFFSET_THRESHOLD = 4
-OP_MAGIC = 0xF1
+OP_MAGIC = 0xF1  # v1 record: no checksum (read-compat only)
+OP_MAGIC2 = 0xF2  # v2 record: crc32-framed (what append_op writes)
 OP_ADD = 1
 OP_REMOVE = 2
 
 _HEADER = struct.Struct("<HHI")
 _META = struct.Struct("<QHHI")
 _OP_HEADER = struct.Struct("<BBI")
+_OP2_HEADER = struct.Struct("<BBII")  # magic, opcode, count, crc32
 _PILOSA_HEADER = struct.Struct("<II")  # cookie, container count
 _PILOSA_META = struct.Struct("<QHH")  # key, type, cardinality-1
 
@@ -370,26 +383,67 @@ def _deserialize_legacy(data: bytes) -> tuple[Bitmap, int]:
 
 
 def append_op(opcode: int, values: np.ndarray) -> bytes:
-    """Encode one ops-log record for appending to a fragment file."""
+    """Encode one ops-log record (v2, crc32-framed) for appending to a
+    fragment file."""
     values = np.asarray(values, dtype=np.uint64)
-    return _OP_HEADER.pack(OP_MAGIC, opcode, values.size) + values.tobytes()
+    body = values.tobytes()
+    crc = zlib.crc32(body, zlib.crc32(
+        _OP_HEADER.pack(OP_MAGIC2, opcode, values.size)
+    ))
+    return _OP2_HEADER.pack(OP_MAGIC2, opcode, values.size, crc) + body
 
 
-def replay_ops(bitmap: Bitmap, data: bytes) -> int:
-    """Apply ops-log records to ``bitmap``; returns number of ops replayed.
+@dataclass
+class ReplayResult:
+    """Outcome of a checked ops-log replay.
 
-    Truncated trailing records (torn writes) are ignored, matching the
-    reference's crash-tolerant ops-log replay.
-    """
+    ``good_bytes`` is the prefix length that replayed cleanly — reopen
+    truncates the on-disk log to it so a torn/corrupt tail can never
+    weld onto the next append. ``corrupt`` is set ONLY for a checksum
+    mismatch on a full-length record (in-place corruption, e.g. a
+    bit-flip); a record that simply runs past EOF is a torn write and
+    reports clean truncation with no error."""
+
+    n_ops: int
+    good_bytes: int
+    corrupt: bool = False
+    corrupt_offset: int = -1
+
+
+def replay_ops_checked(bitmap: Bitmap, data: bytes) -> ReplayResult:
+    """Apply ops-log records to ``bitmap`` with v2 checksum
+    verification; v1 records replay without one (legacy files). Stops at
+    the first torn, corrupt, or unrecognizable record — everything
+    after a bad record is untrusted (its framing may itself be
+    damaged), so recovery is conservative: replay the clean prefix,
+    truncate the rest."""
     pos, n_ops = 0, 0
-    while pos + _OP_HEADER.size <= len(data):
-        magic, opcode, count = _OP_HEADER.unpack_from(data, pos)
-        if magic != OP_MAGIC:
-            break
-        body_end = pos + _OP_HEADER.size + count * 8
-        if body_end > len(data):
-            break  # torn write
-        values = np.frombuffer(data, np.uint64, count, pos + _OP_HEADER.size)
+    n = len(data)
+    while pos + _OP_HEADER.size <= n:
+        magic = data[pos]
+        if magic == OP_MAGIC2:
+            if pos + _OP2_HEADER.size > n:
+                break  # torn mid-header
+            _m, opcode, count, crc = _OP2_HEADER.unpack_from(data, pos)
+            body_start = pos + _OP2_HEADER.size
+            body_end = body_start + count * 8
+            if body_end > n:
+                break  # torn write
+            body = data[body_start:body_end]
+            want = zlib.crc32(body, zlib.crc32(
+                _OP_HEADER.pack(OP_MAGIC2, opcode, count)
+            ))
+            if want != crc:
+                return ReplayResult(n_ops, pos, corrupt=True, corrupt_offset=pos)
+        elif magic == OP_MAGIC:
+            _m, opcode, count = _OP_HEADER.unpack_from(data, pos)
+            body_start = pos + _OP_HEADER.size
+            body_end = body_start + count * 8
+            if body_end > n:
+                break  # torn write
+        else:
+            break  # unrecognized tail byte: treat as torn
+        values = np.frombuffer(data, np.uint64, count, body_start)
         if opcode == OP_ADD:
             bitmap.add_many(values)
         elif opcode == OP_REMOVE:
@@ -398,4 +452,15 @@ def replay_ops(bitmap: Bitmap, data: bytes) -> int:
             break
         pos = body_end
         n_ops += 1
-    return n_ops
+    return ReplayResult(n_ops, pos)
+
+
+def replay_ops(bitmap: Bitmap, data: bytes) -> int:
+    """Apply ops-log records to ``bitmap``; returns number of ops replayed.
+
+    Truncated trailing records (torn writes) are ignored, matching the
+    reference's crash-tolerant ops-log replay. Callers that must REPAIR
+    the file (the fragment reopen path) use ``replay_ops_checked``
+    instead, which also reports how many bytes replayed cleanly and
+    whether a checksum caught in-place corruption."""
+    return replay_ops_checked(bitmap, data).n_ops

@@ -27,6 +27,7 @@ from pilosa_tpu.core import (
 )
 from pilosa_tpu.executor import ExecutionError, Executor, RowResult
 from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import durable
 
 
 # index/field naming rule (reference: validateName in pilosa.go — lowercase
@@ -221,7 +222,8 @@ class API:
         from pilosa_tpu.pql import parse
 
         calls = parse(pql) if isinstance(pql, str) else pql
-        self.check_write_limit(self.count_query_writes(calls), "query")
+        n_writes = self.count_query_writes(calls)
+        self.check_write_limit(n_writes, "query")
         if self.stats is not None and self.cluster is None:
             # single-node served-query counter; clustered serving counts
             # per fan-out leg in parallel/cluster.py instead
@@ -231,6 +233,11 @@ class API:
         # shared dispatch/readback waves (writes and host-routed reads
         # pass through direct — see executor/scheduler.py)
         results = self.scheduler.execute(index, calls, shards=shards)
+        if n_writes:
+            # durability barrier BEFORE the acknowledgement leaves: in
+            # batch WAL mode this group-fsyncs every ops log the query
+            # dirtied (docs/durability.md)
+            durable.ack_barrier()
         return self.build_response(results)
 
     def build_response(self, results: list[Any]) -> dict:
@@ -277,6 +284,7 @@ class API:
             timestamps = [self._parse_ts(t) for t in raw_ts]
         f.import_bulk(rows, cols, timestamps=timestamps, clear=payload.get("clear", False))
         idx.mark_columns_exist(cols)
+        durable.ack_barrier()  # acknowledged ⇒ on disk (docs/durability.md)
 
     def import_values(self, index: str, field: str, payload: dict) -> None:
         """Bulk BSI import (reference: api.ImportValue)."""
@@ -286,12 +294,14 @@ class API:
         cols = self._resolve_cols(idx, payload)
         if payload.get("clear"):
             f.clear_values(cols)
+            durable.ack_barrier()
             return
         values = np.asarray(payload.get("values", []), dtype=np.int64)
         if cols.size != values.size:
             raise ExecutionError("columnIDs and values length mismatch")
         f.import_values(cols, values)
         idx.mark_columns_exist(cols)
+        durable.ack_barrier()  # acknowledged ⇒ on disk (docs/durability.md)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes, view: str = VIEW_STANDARD) -> None:
         """Direct roaring-bitmap union into a fragment (reference:
@@ -310,6 +320,9 @@ class API:
         with frag._lock:
             delta_cols = delta.values() % np.uint64(SHARD_WIDTH)
         idx.mark_columns_exist(delta_cols + np.uint64(shard * SHARD_WIDTH))
+        # the roaring import itself snapshots (atomic write, durable);
+        # the barrier covers the existence-field ops-log appends
+        durable.ack_barrier()
 
     @staticmethod
     def _payload_size(payload: dict) -> int:
@@ -373,7 +386,13 @@ class API:
         store = self._translate_store(index, field)
         if create:
             self.check_write_limit(len(keys), "translate")
-        return store.translate_keys(keys, create=create)
+        ids = store.translate_keys(keys, create=create)
+        if create:
+            # new key→id assignments are acknowledged state: a client
+            # that writes bits under a returned id after a crash must
+            # find the same mapping on replay
+            durable.ack_barrier()
+        return ids
 
     # ------------------------------------------------------------- export
     def fragment_data(

@@ -87,11 +87,18 @@ class DiagnosticsCollector:
         snap = self.snapshot()
         data_dir = os.path.expanduser(self.server.config.data_dir)
         try:
+            from pilosa_tpu.utils import durable
+
             os.makedirs(data_dir, exist_ok=True)
-            tmp = os.path.join(data_dir, ".diagnostics.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump(snap, f, indent=1)
-            os.replace(tmp, os.path.join(data_dir, "diagnostics.json"))
+            # durable=False: best-effort snapshot — atomic replace so a
+            # reader never sees a torn file, no fsyncs (losing one
+            # diagnostics flush to a crash costs nothing)
+            durable.atomic_write_file(
+                os.path.join(data_dir, "diagnostics.json"),
+                json.dumps(snap, indent=1),
+                tmp_suffix=".tmp",
+                durable=False,
+            )
         except OSError:
             pass
 

@@ -193,6 +193,14 @@ class EventHTTPServer(_ServerCore):
         self.keepalive_idle_s = 75.0  # idle keep-alive reap; 0 = never
         self.request_read_timeout_s = 10.0  # slowloris head/body cut
         self.worker_threads = 0  # query-class concurrency; 0 = auto
+        # write-lane backpressure tied to compaction debt (docs/
+        # durability.md): when the holder's queued+in-flight compactions
+        # exceed the limit, write-class requests get 429 + Retry-After —
+        # unchecked ingest past compaction capacity grows every ops log
+        # (and crash-replay time) without bound. 0 disables; the debt
+        # callable is wired by Server.open.
+        self.compaction_max_debt = 0
+        self.compaction_debt = None
         self._loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._stop: asyncio.Event | None = None
@@ -562,6 +570,23 @@ class EventHTTPServer(_ServerCore):
         """Admission control + worker hand-off.  Returns False when the
         connection must close."""
         adm = self._admission[cls]
+        if (
+            cls == _CLASS_WRITE
+            and self.compaction_max_debt > 0
+            and self.compaction_debt is not None
+            and self.compaction_debt() > self.compaction_max_debt
+        ):
+            self._reject("compaction_debt")
+            # the write path is ahead of compaction capacity: shed the
+            # write at the door (429, keep-alive intact — the body was
+            # fully consumed) instead of letting ops logs and crash-
+            # replay time grow without bound (docs/durability.md)
+            await self._write_simple(
+                writer, 429,
+                "compaction debt exceeds compaction-max-debt; retry",
+                retry_after="1", close=False,
+            )
+            return True
         if adm.depth > 0 and adm.waiting >= adm.depth:
             self._reject("queue_full")
             # bounded queues are the backpressure contract: shed load

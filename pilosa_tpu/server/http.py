@@ -518,6 +518,14 @@ class Handler(BaseHTTPRequestHandler):
         # serving front end: connection counts, admission queue state,
         # per-class concurrency limits (docs/serving.md)
         out["serving"] = self.server.serving_snapshot()
+        # durable write protocol: WAL fsync mode + dirty-file count, and
+        # the background compactor's queue/debt state (docs/durability.md)
+        from pilosa_tpu.utils import durable
+
+        out["durability"] = {
+            "wal": durable.wal_snapshot(),
+            "compaction": self.api.holder.compactor.snapshot(),
+        }
         self._json(out)
 
     def h_debug_traces(self) -> None:
@@ -562,7 +570,15 @@ class Handler(BaseHTTPRequestHandler):
         return inj
 
     def h_debug_faults(self) -> None:
-        self._json(self._fault_injector().snapshot())
+        out = self._fault_injector().snapshot()
+        fs = getattr(self.server, "fs_fault_injector", None)
+        if fs is not None:
+            # filesystem fault layer (docs/durability.md): read-only
+            # here — FS rules arm via config (fs-fault-rules), because
+            # installing the process-wide hook mid-flight would race
+            # in-progress write protocols
+            out["fs"] = fs.snapshot()
+        self._json(out)
 
     def h_debug_faults_set(self) -> None:
         body = self._json_body()
@@ -668,6 +684,9 @@ class _ServerCore:
         # /debug/faults routes drive the same rule set the node's
         # outgoing data-plane client consults
         self.fault_injector = None
+        # ... and its FSFaultInjector (docs/durability.md) so GET
+        # /debug/faults reports the armed disk-fault rules too
+        self.fs_fault_injector = None
         # device-probe gate: the runtime Server swaps in a hook that
         # blocks query/import dispatch (bounded) until the backend probe
         # verdict lands — True = proceed, False = serve 503 + Retry-After

@@ -27,7 +27,6 @@ _MESH_ATTACH_WARNED = False
 class Server:
     def __init__(self, config: Config | None = None):
         self.config = config or Config()
-        self.holder = Holder(os.path.expanduser(self.config.data_dir))
         from pilosa_tpu.utils.stats import make_stats
 
         self.stats = make_stats(
@@ -40,14 +39,32 @@ class Server:
             if self.config.log_path
             else None
         )
+        # WAL acknowledgement policy (docs/durability.md) is process-
+        # global — set it before the holder exists so even open()-time
+        # repairs write under the configured mode
+        from pilosa_tpu.utils import durable
+
+        durable.set_wal_fsync_mode(self.config.wal_fsync_mode)
+        self.holder = Holder(
+            os.path.expanduser(self.config.data_dir),
+            compaction_workers=self.config.compaction_workers,
+            load_workers=self.config.holder_load_workers,
+            stats=self.stats,
+        )
         self.cluster = None
         # deterministic fault injection (docs/fault-tolerance.md):
         # always constructed — zero cost unarmed — so the /debug/faults
         # route can arm rules on a live node; the cluster's outgoing
         # client chain consults this same instance
-        from pilosa_tpu.parallel.faultinject import FaultInjector
+        from pilosa_tpu.parallel.faultinject import FaultInjector, FSFaultInjector
 
         self.fault_injector = FaultInjector.from_config(self.config)
+        # filesystem fault layer (docs/durability.md): installed process-
+        # wide in open() ONLY when rules are armed — the durable write
+        # protocol consults the hook at every primitive, and the chaos
+        # suite needs the faults to land exactly where real disk faults
+        # would. Uninstalled in close().
+        self.fs_fault_injector = FSFaultInjector.from_config(self.config)
         # per-call host/device cost router (docs/query-routing.md),
         # seeded from config; the SAME router instance survives the
         # late mesh attach so its calibration carries over
@@ -98,6 +115,12 @@ class Server:
         backlog for the full client timeout instead of getting an instant
         connection-refused — concurrent cold starts then stack 30s
         timeouts on each other."""
+        if self.fs_fault_injector.armed:
+            # before holder.open(): crash-recovery rehearsals target the
+            # load path (snapshot reads, torn-tail truncation) too
+            from pilosa_tpu.utils import durable
+
+            durable.install_fs_hook(self.fs_fault_injector)
         self.holder.open()
         # event-driven front end by default (docs/serving.md); the
         # legacy thread-per-request listener stays as a rollback knob
@@ -118,6 +141,11 @@ class Server:
             self.http.keepalive_idle_s = self.config.keepalive_idle_s
             self.http.request_read_timeout_s = self.config.request_read_timeout_s
             self.http.worker_threads = self.config.http_worker_threads
+            # write-class backpressure tied to compaction debt
+            # (docs/durability.md): past the limit, imports get 429 +
+            # Retry-After instead of growing ops logs without bound
+            self.http.compaction_max_debt = self.config.compaction_max_debt
+            self.http.compaction_debt = self.holder.compactor.debt
         if self.config.tls_certificate:
             # serve HTTPS (reference: tls.certificate/tls.key). The context
             # is handed to the listener, which wraps each accepted
@@ -136,6 +164,7 @@ class Server:
         self.http.long_query_time = self.config.long_query_time
         self.http.query_timeout_ms = self.config.query_timeout_ms
         self.http.fault_injector = self.fault_injector
+        self.http.fs_fault_injector = self.fs_fault_injector
         self.http.log = self.logger.log
         self.http.gate = self._query_gate
         if self.config.seeds or self.config.coordinator:
@@ -377,4 +406,8 @@ class Server:
             self.http.server_close()
         self.stats.close()
         self.holder.close()
+        if self.fs_fault_injector.armed:
+            from pilosa_tpu.utils import durable
+
+            durable.install_fs_hook(None)
         self.logger.close()

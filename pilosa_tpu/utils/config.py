@@ -147,6 +147,29 @@ class Config:
     # reproducibility; also settable at runtime via /debug/faults
     fault_rules: str = ""
     fault_seed: int = 0
+    # filesystem fault injection (docs/durability.md): a JSON list of
+    # rules applied to the durable write protocol's primitives (ops-log
+    # appends, snapshot writes, fsyncs, renames, dir-fsyncs), seeded by
+    # the shared fault-seed; drives the disk-fault chaos suite
+    fs_fault_rules: str = ""
+    # durability (docs/durability.md): when an ops-log append becomes
+    # durable relative to the write acknowledgement. "always" fsyncs
+    # inside every append; "batch" group-fsyncs all dirty WAL files once
+    # at the request's acknowledgement barrier (the default — group
+    # commit); "off" never fsyncs (page-cache-only, acknowledged writes
+    # can die with the OS)
+    wal_fsync_mode: str = "batch"
+    # background ops-log→snapshot compaction worker threads per holder
+    compaction_workers: int = 1
+    # queued+in-flight compactions past which the event front end's
+    # write lane answers 429 + Retry-After instead of growing the
+    # ops logs (and crash-replay time) without bound; 0 = no limit
+    compaction_max_debt: int = 64
+    # concurrent fragment opens (snapshot deserialize + ops-log replay)
+    # during Holder.open — restart-to-serving is bounded by the slowest
+    # fragment, not the sum; <=1 loads serially. Device upload stays
+    # lazy (first query per stack) either way.
+    holder_load_workers: int = 8
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -273,6 +296,11 @@ def config_template() -> str:
         "breaker-cooldown-ms = 5000.0\n"
         'fault-rules = ""\n'
         "fault-seed = 0\n"
+        'fs-fault-rules = ""\n'
+        'wal-fsync-mode = "batch"\n'
+        "compaction-workers = 1\n"
+        "compaction-max-debt = 64\n"
+        "holder-load-workers = 8\n"
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

@@ -62,18 +62,23 @@ def load(ttl_s: float, pin: str = "") -> dict | None:
 def store(ok: bool, pin: str = "", platform: str = "") -> None:
     path = cache_path()
     try:
+        from pilosa_tpu.utils import durable
+
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
-        tmp = f"{path}.tmp.{os.getpid()}"
-        with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(
+        # durable=False: atomic replace without the fsyncs — a probe
+        # verdict lost to a crash just costs one fresh probe
+        durable.atomic_write_file(
+            path,
+            json.dumps(
                 {
                     "ok": bool(ok),
                     "pin": pin or "",
                     "platform": platform,
                     "time": time.time(),
-                },
-                f,
-            )
-        os.replace(tmp, path)
+                }
+            ),
+            tmp_suffix=f".tmp.{os.getpid()}",
+            durable=False,
+        )
     except Exception:  # noqa: BLE001 — persistence is best-effort
         pass

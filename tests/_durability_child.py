@@ -1,0 +1,68 @@
+"""Crash-test child for the kill-9 recovery suite (tests/test_durability.py).
+
+Ingests batches into a real Holder with seeded filesystem fault rules
+armed; one rule SIGKILLs the process at an exact point of the durable
+write protocol (mid-WAL-append, mid-snapshot-write, pre-rename,
+pre-dir-fsync, mid-compaction — wherever the parent aimed it).  Every
+batch is ACKNOWLEDGED on stdout only after its durability barrier
+returns, so the parent can assert the recovery invariant: zero
+acknowledged batches lost across the kill.
+
+Usage: python _durability_child.py <data_dir> <rules_json> [wal_mode]
+
+Not collected by pytest (no ``test_`` prefix).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+
+import numpy as np
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.parallel.faultinject import FSFaultInjector
+from pilosa_tpu.utils import durable
+
+BATCHES = 400
+BITS_PER_BATCH = 8
+
+
+def batch_bits(b: int) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic per-batch bit set — the parent recomputes this to
+    verify recovery. Columns stay inside shard 0 at the test width."""
+    rows = np.full(BITS_PER_BATCH, b % 4, dtype=np.uint64)
+    cols = np.arange(
+        b * BITS_PER_BATCH, (b + 1) * BITS_PER_BATCH, dtype=np.uint64
+    )
+    return rows, cols
+
+
+def main() -> int:
+    data_dir = sys.argv[1]
+    rules = json.loads(sys.argv[2])
+    durable.set_wal_fsync_mode(sys.argv[3] if len(sys.argv) > 3 else "batch")
+    h = Holder(data_dir, compaction_workers=1)
+    h.open()
+    idx = h.create_index("i")
+    fld = idx.create_field("f")
+    # arm AFTER the schema writes: the rules aim at fragment I/O (the
+    # parent scopes them by path substring + occurrence count anyway)
+    durable.install_fs_hook(FSFaultInjector(rules, seed=7))
+    for b in range(BATCHES):
+        rows, cols = batch_bits(b)
+        fld.import_bulk(rows, cols)
+        # tiny snapshot threshold: keeps the background compactor hot so
+        # compaction-phase crash points are reached within the run
+        for v in fld.views.values():
+            for frag in v.fragments.values():
+                frag.max_op_n = 8
+        durable.ack_barrier()
+        print(f"ACK {b}", flush=True)
+    h.close()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,21 @@
+"""Seeded durability violations: bare write-mode opens beneath the
+holder path and a naked os.replace — writes a crash can lose or tear,
+invisible to the FS fault hooks inside the sanctioned helpers."""
+
+import json
+import os
+
+
+class MetaStore:
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, meta: dict) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:  # BAD: bare write-mode open in core/
+            json.dump(meta, f)
+        os.replace(tmp, self.path)  # BAD: naked rename, no dir fsync
+
+    def append_op(self, record: bytes) -> None:
+        with open(self.path + ".ops", "ab") as f:  # BAD: bare append
+            f.write(record)

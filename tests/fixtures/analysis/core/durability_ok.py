@@ -1,0 +1,29 @@
+"""Clean twin for the durability rule: a holder-layer store whose
+persistent writes all go through the sanctioned utils/durable helpers
+(read-mode opens stay ordinary)."""
+
+import json
+import os
+
+from pilosa_tpu.utils import durable
+
+
+class MetaStore:
+    def __init__(self, path: str):
+        self.path = path
+
+    def save(self, meta: dict) -> None:
+        os.makedirs(os.path.dirname(self.path), exist_ok=True)
+        # crash-safe whole-file write: tmp → fsync → rename → dir fsync
+        durable.atomic_write_file(self.path, json.dumps(meta))
+
+    def append_op(self, record: bytes) -> None:
+        # WAL append under the acknowledgement fsync policy
+        durable.append_wal(self.path + ".ops", record)
+
+    def load(self) -> dict:
+        with open(self.path) as f:  # read-mode: not a durability concern
+            return json.load(f)
+
+    def repair(self, good_bytes: int) -> None:
+        durable.truncate_file(self.path + ".ops", good_bytes)

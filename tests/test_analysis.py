@@ -76,6 +76,7 @@ def test_rule_registry_complete():
         "wall-clock",
         "resilience",
         "asyncpurity",
+        "durability",
     ):
         assert name in out, f"rule {name} missing from registry"
 
@@ -92,6 +93,8 @@ def test_rule_registry_complete():
         ),
         ("resilience_bad.py", ["resilience"]),
         ("asyncpurity_bad.py", ["asyncpurity"]),
+        # lives under core/ so the holder-data-layer scope applies
+        ("core/durability_bad.py", ["durability"]),
     ],
 )
 def test_seeded_fixture_fails(fixture, rules):
@@ -109,6 +112,7 @@ def test_seeded_fixture_fails(fixture, rules):
         "banned_ok.py",
         "resilience_ok.py",
         "asyncpurity_ok.py",
+        "core/durability_ok.py",
     ],
 )
 def test_clean_fixture_passes(fixture):
@@ -424,6 +428,33 @@ def test_resilience_unflagged_write_leg_fails(tree_copy):
     rc, out = check_tree(tree_copy)
     assert rc != 0
     assert "[resilience]" in out and "write=True" in out
+
+
+def test_durability_bare_oplog_append_fails(tree_copy):
+    # regress the ops-log append to a bare open(): the write leaves the
+    # WAL fsync policy AND the FS fault hook — acknowledged bits could
+    # die in the page cache and the chaos suite would never know
+    mutate(
+        tree_copy / "pilosa_tpu" / "core" / "fragment.py",
+        "durable.append_wal(self.path, roaring.append_op(opcode, values))",
+        'open(self.path, "ab").write(roaring.append_op(opcode, values))',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[durability]" in out and "bare write-mode open" in out
+
+
+def test_durability_rename_without_dirfsync_fails(tree_copy):
+    # drop the parent-dir fsync from the sanctioned rename: every
+    # atomic write in the tree silently loses its crash guarantee
+    mutate(
+        tree_copy / "pilosa_tpu" / "utils" / "durable.py",
+        "fsync_dir(os.path.dirname(os.path.abspath(dst)))",
+        "os.path.dirname(os.path.abspath(dst))",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[durability]" in out and "replace_durable" in out
 
 
 def test_asyncpurity_sleep_in_coroutine_fails(tree_copy):

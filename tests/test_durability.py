@@ -1,0 +1,994 @@
+"""Durable write protocol + disk-fault chaos suite (docs/durability.md).
+
+Everything here runs against SEEDED, deterministic filesystem fault
+rules (parallel/faultinject.py FSFaultInjector) threaded through the
+durable write protocol (utils/durable.py) — no real disk chaos:
+
+- op-log replay properties: a torn tail at EVERY byte offset of the
+  final record truncates cleanly; a bit-flip in a record body is caught
+  by the crc32 frame and reported with fragment path + offset; an
+  empty ops region reopens snapshot-only;
+- the crash matrix, in-process (SimulatedCrash tears through the write
+  protocol exactly where SIGKILL would): zero acknowledged writes lost
+  at {mid-oplog-append, mid-snapshot-write, pre-rename, pre-dir-fsync,
+  mid-compaction};
+- background compaction: folds off the write path, dedupes, survives
+  EIO and crash with the old snapshot authoritative, and ``Set()``
+  stays bounded while a compaction is wedged (injectable-sleep clock);
+- WAL acknowledgement fsync policy: ``always`` fsyncs per append,
+  ``batch`` group-fsyncs at the ack barrier, ``off`` never;
+- the event front end's write lane answers 429 (not a hang) past
+  ``compaction-max-debt``;
+- parallel holder cold start loads the same data as serial;
+- the kill-9 subprocess recovery suite (``slow`` marker): a child
+  ingests acknowledged batches, a seeded rule SIGKILLs it at each
+  crash point, the parent reopens the holder and proves zero
+  acknowledged batches lost.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from pilosa_tpu import roaring
+from pilosa_tpu.core import Holder
+from pilosa_tpu.core.compact import Compactor
+from pilosa_tpu.core.fragment import Fragment
+from pilosa_tpu.parallel.faultinject import FSFaultInjector
+from pilosa_tpu.roaring.serialize import _OP2_HEADER
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils import durable
+from pilosa_tpu.utils.config import Config
+from pilosa_tpu.utils.durable import SimulatedCrash
+
+pytestmark = pytest.mark.faults
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------- harness
+@pytest.fixture
+def fs_hook():
+    """Install a seeded FS fault injector; ALWAYS uninstalled after the
+    test — the hook is process-global."""
+    def install(rules, seed=7, sleep=time.sleep):
+        inj = FSFaultInjector(rules, seed=seed, sleep=sleep)
+        durable.install_fs_hook(inj)
+        return inj
+
+    yield install
+    durable.install_fs_hook(None)
+
+
+@pytest.fixture
+def wal_mode():
+    """Set the process-global WAL fsync mode; restored after the test."""
+    prev = durable.wal_fsync_mode()
+    yield durable.set_wal_fsync_mode
+    durable.set_wal_fsync_mode(prev)
+
+
+def make_fragment(tmp_path, name="frag0"):
+    frag = Fragment(str(tmp_path / name), "i", "f", "standard", 0)
+    frag.open()
+    return frag
+
+
+def reopen(frag) -> Fragment:
+    f2 = Fragment(frag.path, frag.index, frag.field, frag.view, frag.shard)
+    f2.open()
+    return f2
+
+
+def op_record(opcode, values) -> bytes:
+    return roaring.append_op(opcode, np.asarray(values, dtype=np.uint64))
+
+
+# ----------------------------------------------- op-log replay properties
+def test_torn_tail_truncates_at_every_byte_offset():
+    recs = [
+        op_record(roaring.OP_ADD, [1, 2, 3]),
+        op_record(roaring.OP_REMOVE, [2]),
+        op_record(roaring.OP_ADD, [7, 9]),
+    ]
+    data = b"".join(recs)
+    base = len(recs[0]) + len(recs[1])
+    for cut in range(base, len(data)):
+        bm = roaring.Bitmap()
+        res = roaring.replay_ops_checked(bm, data[:cut])
+        assert res.n_ops == 2, f"cut at {cut}"
+        assert res.good_bytes == base, f"cut at {cut}"
+        assert not res.corrupt, f"cut at {cut}"
+        assert sorted(bm.values().tolist()) == [1, 3], f"cut at {cut}"
+    # and the whole log replays all three
+    bm = roaring.Bitmap()
+    res = roaring.replay_ops_checked(bm, data)
+    assert res.n_ops == 3 and res.good_bytes == len(data)
+    assert sorted(bm.values().tolist()) == [1, 3, 7, 9]
+
+
+def test_bitflip_in_record_body_detected_with_offset():
+    recs = [
+        op_record(roaring.OP_ADD, [10]),
+        op_record(roaring.OP_ADD, [20]),
+        op_record(roaring.OP_ADD, [30]),
+    ]
+    flipped = bytearray(b"".join(recs))
+    # flip one byte inside the SECOND record's value payload
+    at = len(recs[0]) + _OP2_HEADER.size
+    flipped[at] ^= 0xFF
+    bm = roaring.Bitmap()
+    res = roaring.replay_ops_checked(bm, bytes(flipped))
+    assert res.corrupt
+    assert res.corrupt_offset == len(recs[0])
+    assert res.n_ops == 1 and res.good_bytes == len(recs[0])
+    assert sorted(bm.values().tolist()) == [10]
+
+
+def test_empty_ops_log_is_snapshot_only():
+    bm = roaring.Bitmap()
+    res = roaring.replay_ops_checked(bm, b"")
+    assert res.n_ops == 0 and res.good_bytes == 0 and not res.corrupt
+
+
+def test_v1_records_still_replay():
+    # legacy (pre-crc) frames interleave with v2 — read-compat
+    import struct
+
+    v1 = struct.pack("<BBI", 0xF1, roaring.OP_ADD, 2) + np.array(
+        [5, 6], dtype=np.uint64
+    ).tobytes()
+    v2 = op_record(roaring.OP_ADD, [7])
+    bm = roaring.Bitmap()
+    res = roaring.replay_ops_checked(bm, v1 + v2)
+    assert res.n_ops == 2
+    assert sorted(bm.values().tolist()) == [5, 6, 7]
+
+
+def test_translate_log_torn_tail_truncated_before_append(tmp_path):
+    """The translate-key WAL must truncate a torn tail BEFORE reopening
+    for append: a new record welded onto a partial line makes one
+    unparseable line, and the SECOND reopen would then silently drop
+    every acknowledged binding appended after the weld."""
+    from pilosa_tpu.core.translate import TranslateStore
+
+    path = str(tmp_path / "keys")
+    st = TranslateStore(path)
+    st.open()
+    a = st.translate_key("alpha")
+    st.close()
+    with open(path, "ab") as f:
+        f.write(b'{"k": "be')  # crash mid-append: partial line, no \n
+    st2 = TranslateStore(path)
+    st2.open()
+    assert st2.translate_key("alpha", create=False) == a
+    b = st2.translate_key("beta")  # acknowledged post-crash binding
+    st2.close()
+    st3 = TranslateStore(path)
+    st3.open()  # the reopen that used to lose everything past a weld
+    assert st3.translate_key("alpha", create=False) == a
+    assert st3.translate_key("beta", create=False) == b
+    st3.close()
+
+
+def test_fragment_reopen_truncates_torn_tail(tmp_path, wal_mode):
+    wal_mode("off")  # keep the on-disk layout byte-predictable
+    frag = make_fragment(tmp_path)
+    frag.set_bit(0, 1)
+    frag.set_bit(1, 2)
+    # tear the final record mid-body on disk
+    size = os.path.getsize(frag.path)
+    with open(frag.path, "r+b") as f:
+        f.truncate(size - 3)
+    f2 = reopen(frag)
+    assert f2.last_recovery["tornBytes"] > 0
+    assert not f2.last_recovery["corrupt"]
+    assert f2.contains(0, 1) and not f2.contains(1, 2)
+    assert f2.op_n == 1
+    # the repair truncated the file: appending now welds onto a clean
+    # tail, and a further reopen sees both generations
+    f2.set_bit(2, 3)
+    f3 = reopen(f2)
+    assert f3.contains(0, 1) and f3.contains(2, 3)
+
+
+def test_fragment_reopen_reports_corruption_offset(tmp_path, wal_mode):
+    wal_mode("off")
+    frag = make_fragment(tmp_path)
+    frag.set_bit(0, 1)
+    frag.set_bit(1, 2)
+    frag.set_bit(2, 3)
+    rec = len(op_record(roaring.OP_ADD, [0]))  # all records: 1 value
+    ops_start = os.path.getsize(frag.path) - 3 * rec
+    flip_at = ops_start + rec + _OP2_HEADER.size  # 2nd record's body
+    with open(frag.path, "r+b") as f:
+        f.seek(flip_at)
+        byte = f.read(1)
+        f.seek(flip_at)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    f2 = reopen(frag)
+    assert f2.last_recovery["corrupt"]
+    assert f2.last_recovery["corruptOffset"] == ops_start + rec
+    # conservative repair: the clean prefix replays, the untrusted tail
+    # (including the RECORD AFTER the corrupt one) is gone
+    assert f2.contains(0, 1)
+    assert not f2.contains(1, 2) and not f2.contains(2, 3)
+    assert os.path.getsize(f2.path) == ops_start + rec
+
+
+@pytest.mark.parametrize("suffix", [".snapshotting", ".compacting"])
+def test_stale_snapshotting_tmp_discarded(tmp_path, suffix):
+    frag = make_fragment(tmp_path)
+    frag.set_bit(0, 5)
+    with open(frag.path + suffix, "wb") as f:
+        f.write(b"half-written garbage")
+    f2 = reopen(frag)
+    assert not os.path.exists(f2.path + suffix)
+    assert f2.contains(0, 5)
+
+
+def test_corrupt_snapshot_quarantined(tmp_path):
+    frag = make_fragment(tmp_path)
+    frag.set_bit(0, 5)
+    frag.snapshot()
+    with open(frag.path, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")  # smash the roaring header
+    f2 = reopen(frag)
+    assert f2.last_recovery["quarantined"]
+    assert os.path.exists(f2.path + ".corrupt")
+    assert not f2.contains(0, 5)  # reopened empty, loudly — never
+    # adopt bytes the atomic-replace protocol didn't commit
+
+
+# ------------------------------------------ in-process crash matrix
+# each entry: a rule aimed at an exact protocol point; the fold is
+# driven synchronously so the crash lands deterministically (the kill-9
+# suite below exercises the same points through the async worker)
+CRASH_POINTS = [
+    ("mid-oplog-append", {"op": "wal-append", "action": "torn",
+                          "cap_bytes": 5}),
+    ("mid-snapshot-write", {"op": "snapshot-write", "action": "torn",
+                            "cap_bytes": 9}),
+    ("pre-rename", {"op": "rename", "action": "crash"}),
+    ("pre-dir-fsync", {"op": "dirfsync", "action": "crash"}),
+    ("mid-compaction", {"op": "snapshot-write", "action": "crash"}),
+]
+
+
+@pytest.mark.parametrize("point,rule", CRASH_POINTS, ids=[p for p, _ in CRASH_POINTS])
+def test_crash_recovery_in_process(tmp_path, fs_hook, wal_mode, point, rule):
+    """The crash matrix without the subprocess: SimulatedCrash tears
+    through the write protocol at the armed point; a reopen from disk
+    must hold every acknowledged write."""
+    wal_mode("always")
+    frag = make_fragment(tmp_path)
+    acked: list[int] = []
+    for b in range(12):  # fsynced per append: acknowledged on return
+        frag.set_bit(b % 3, b)
+        acked.append(b)
+    inj = fs_hook([rule])
+    with pytest.raises(SimulatedCrash):
+        if point == "mid-oplog-append":
+            frag.set_bit(0, 999)  # dies mid-record: never acknowledged
+        else:
+            frag.compact()  # dies at the armed fold step
+    durable.install_fs_hook(None)
+    assert sum(r.fires for r in inj._rules) == 1
+    f2 = reopen(frag)
+    for b in acked:
+        assert f2.contains(b % 3, b), (
+            f"{point}: acknowledged write {b} lost after crash"
+        )
+    assert not f2.contains(0, 999)
+    # the repaired state accepts writes and survives another reopen
+    f2.set_bit(3, 100)
+    f3 = reopen(f2)
+    assert f3.contains(3, 100) and all(f3.contains(b % 3, b) for b in acked)
+
+
+def test_worker_contains_crash_and_old_snapshot_stays_valid(
+    tmp_path, fs_hook, wal_mode
+):
+    """Crash-mid-compaction through the REAL background worker: the
+    SimulatedCrash is contained (counted, worker survives), the old
+    snapshot stays authoritative, and the next fold succeeds."""
+    wal_mode("always")
+    frag = make_fragment(tmp_path)
+    compactor = Compactor(workers=1)
+    frag._compactor = compactor
+    frag.max_op_n = 4
+    fs_hook([{"op": "snapshot-write", "action": "crash", "path": "frag0"}])
+    acked = []
+    b = 0
+    deadline = time.monotonic() + 15.0
+    while not compactor.crashed and time.monotonic() < deadline:
+        frag.set_bit(b % 3, b)
+        acked.append(b)
+        b += 1
+        time.sleep(0.001)
+    assert compactor.crashed >= 1, "the armed crash never reached the worker"
+    durable.install_fs_hook(None)
+    # the rule fired once (times=1 default); op_n is still over the
+    # threshold, so the next append re-queues the fold — which now
+    # goes through
+    for _ in range(3):
+        frag.set_bit(b % 3, b)
+        acked.append(b)
+        b += 1
+    assert compactor.wait_idle(10)
+    compactor.close()
+    assert compactor.compacted >= 1
+    f2 = reopen(frag)
+    for a in acked:
+        assert f2.contains(a % 3, a), f"acknowledged write {a} lost"
+
+
+def test_background_compaction_folds_ops(tmp_path):
+    frag = make_fragment(tmp_path)
+    compactor = Compactor(workers=1)
+    frag._compactor = compactor
+    frag.max_op_n = 4
+    for b in range(30):
+        frag.set_bit(0, b)
+    assert compactor.wait_idle(10)
+    compactor.close()
+    assert frag.op_n <= 4  # folded into the snapshot off the write path
+    assert compactor.compacted >= 1
+    f2 = reopen(frag)
+    assert all(f2.contains(0, b) for b in range(30))
+    assert f2.op_n == frag.op_n
+
+
+def test_compaction_dedupes_concurrent_requests(tmp_path):
+    frag = make_fragment(tmp_path)
+    gate = threading.Event()
+    compactor = Compactor(workers=1)
+    durable.install_fs_hook(
+        FSFaultInjector(
+            [{"op": "snapshot-write", "action": "delay", "delay_ms": 1e6,
+              "times": 1}],
+            sleep=lambda _s: gate.wait(10),
+        )
+    )
+    try:
+        frag.set_bit(0, 1)
+        assert compactor.request(frag)  # worker parks in snapshot-write
+        time.sleep(0.05)
+        assert not compactor.request(frag)  # in flight: deduped
+        assert compactor.debt() == 1
+    finally:
+        gate.set()
+        durable.install_fs_hook(None)
+        compactor.close()
+
+
+def test_eio_keeps_old_snapshot_authoritative(tmp_path, fs_hook):
+    frag = make_fragment(tmp_path)
+    compactor = Compactor(workers=1)
+    frag._compactor = compactor
+    frag.max_op_n = 4
+    fs_hook([{"op": "snapshot-write", "action": "eio", "times": 10,
+              "path": "frag0"}])
+    for b in range(12):
+        frag.set_bit(0, b)
+    compactor.wait_idle(10)
+    durable.install_fs_hook(None)
+    assert compactor.failed >= 1
+    # the disk said no; nothing lost — ops log kept growing instead
+    f2 = reopen(frag)
+    assert all(f2.contains(0, b) for b in range(12))
+    # and once the disk recovers, the retry folds it
+    frag.set_bit(0, 99)
+    for b in range(5):
+        frag.set_bit(1, b)
+    assert compactor.wait_idle(10)
+    compactor.close()
+    assert compactor.compacted >= 1
+
+
+def test_set_latency_bounded_under_wedged_compaction(tmp_path, fs_hook):
+    """The write path must not wait for a compaction: with the worker
+    wedged inside the snapshot write (injectable sleep — the fake
+    clock), Set() completes immediately and the fold lands later."""
+    frag = make_fragment(tmp_path)
+    gate = threading.Event()
+    compactor = Compactor(workers=1)
+    frag._compactor = compactor
+    frag.max_op_n = 4
+    fs_hook(
+        [{"op": "snapshot-write", "action": "delay", "delay_ms": 1e6,
+          "times": 1, "path": "frag0"}],
+        sleep=lambda _s: gate.wait(30),
+    )
+    try:
+        for b in range(6):  # trips the threshold → worker parks
+            frag.set_bit(0, b)
+        deadline = time.monotonic() + 5.0
+        while not compactor.debt() and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert compactor.debt() == 1
+        done = threading.Event()
+
+        def writer():
+            for b in range(6, 30):
+                frag.set_bit(0, b)
+            done.set()
+
+        t = threading.Thread(target=writer)
+        t.start()
+        # bounded: 24 sets complete while the compactor is WEDGED — the
+        # old inline path would park the first threshold-tripping Set
+        # for the full snapshot duration (here: forever)
+        assert done.wait(5.0), "Set() blocked behind a wedged compaction"
+        t.join()
+    finally:
+        gate.set()
+    assert compactor.wait_idle(10)
+    compactor.close()
+    f2 = reopen(frag)
+    assert all(f2.contains(0, b) for b in range(30))
+
+
+class _InlineSnapshotDuringCompact:
+    """durable.py hook that fires an inline snapshot-path mutation while
+    ``compact()`` is OFF the fragment lock — its first disk touch is the
+    ``snapshot-write`` check for the tmp, which is exactly the
+    stale-clone window."""
+
+    def __init__(self, frag):
+        self.frag = frag
+        self.fired = False
+
+    def check(self, op, path):
+        if op == "snapshot-write" and not self.fired:
+            self.fired = True
+            # bulk-import shape: union + INLINE snapshot() under the
+            # fragment lock — rewrites the file compact() cloned against
+            self.frag.union_positions(np.array([777_777], dtype=np.uint64))
+
+    def write_cap(self, op, path, nbytes):
+        return None
+
+    def torn(self, op, path):  # pragma: no cover - protocol stub
+        pass
+
+
+def test_compact_aborts_when_inline_snapshot_rewrote_the_file(tmp_path):
+    """An inline snapshot() (bulk-import adopt, anti-entropy merge) that
+    lands while compact() is serializing off-lock has already folded
+    every op; compact must ABORT its commit — welding the new file's
+    bytes past its stale base offset onto the stale clone would clobber
+    acknowledged data on disk and drive op_n negative."""
+    frag = make_fragment(tmp_path)
+    for b in range(10):
+        frag.set_bit(0, b)
+    hook = _InlineSnapshotDuringCompact(frag)
+    durable.install_fs_hook(hook)
+    try:
+        frag.compact()
+    finally:
+        durable.install_fs_hook(None)
+    assert hook.fired
+    assert frag.op_n >= 0
+    f2 = reopen(frag)
+    assert all(f2.contains(0, b) for b in range(10))
+    assert 777_777 in f2.bitmap.values().tolist()
+    assert f2.last_recovery["tornBytes"] == 0
+    assert not f2.last_recovery["corrupt"]
+
+
+def _make_view(tmp_path, name="v"):
+    from pilosa_tpu.core.view import View
+
+    return View("standard", "i", "f", str(tmp_path / name), "ranked", 1000)
+
+
+def test_queued_compaction_cannot_resurrect_removed_fragment(tmp_path):
+    """A resize handoff drops a fragment while a compaction for it is
+    still queued (or in flight): the fold must become a no-op, not
+    recreate the file — which the next holder reopen would re-adopt,
+    serving a shard this node relinquished."""
+    view = _make_view(tmp_path)
+    frag = view.create_fragment_if_not_exists(0)
+    for b in range(8):
+        frag.set_bit(0, b)
+    assert view.remove_fragment(0)
+    assert not os.path.exists(frag.path)
+    frag.compact()  # the queued run, arriving after the drop
+    assert not os.path.exists(frag.path)
+    compactor = Compactor(workers=1)
+    assert not compactor.request(frag)  # dropped: not even queued
+    compactor.close()
+    # a stale reference's late bulk write (inline-snapshot path) must
+    # not resurrect the file either
+    frag.union_positions(np.array([3], dtype=np.uint64))
+    assert not os.path.exists(frag.path)
+
+
+def test_worker_survives_unexpected_compact_error(tmp_path):
+    """A compact() raising something OTHER than OSError (a serialize
+    limit, a codec bug) must not kill the daemon worker: with one dead
+    worker, debt grows past compaction-max-debt and the write lane
+    would 429 forever."""
+    bad = make_fragment(tmp_path, "bad")
+    good = make_fragment(tmp_path, "good")
+    bad.compact = lambda: (_ for _ in ()).throw(ValueError("codec bug"))
+    compactor = Compactor(workers=1)
+    assert compactor.request(bad)
+    assert compactor.wait_idle(10)
+    assert compactor.failed == 1
+    # the worker is still alive and folds the next fragment
+    good.set_bit(0, 1)
+    assert compactor.request(good)
+    assert compactor.wait_idle(10)
+    compactor.close()
+    assert compactor.compacted == 1
+
+
+def test_torn_rule_not_consumed_by_smaller_write():
+    """A torn rule whose cap exceeds the write tears nothing — it must
+    stay armed (not burn its `fires`) or the chaos scenario passes
+    without ever exercising recovery."""
+    inj = FSFaultInjector(
+        [{"op": "wal-append", "action": "torn", "cap_bytes": 64,
+          "times": 1}]
+    )
+    assert inj.write_cap("wal-append", "x", 40) is None  # nothing to tear
+    assert inj.snapshot()["rules"][0]["fires"] == 0
+    assert inj.write_cap("wal-append", "x", 100) == 64  # now it fires
+    assert inj.snapshot()["rules"][0]["fires"] == 1
+
+
+def test_cold_start_opens_shards_concurrently(tmp_path, monkeypatch):
+    """holder-load-workers only helps if fragment OPEN (the snapshot
+    deserialize + replay that dominates cold start) runs outside any
+    view-wide lock: two workers opening DIFFERENT shards of the same
+    view must be inside open() at the same time."""
+    seed = _make_view(tmp_path)
+    for shard in (0, 1):
+        seed.create_fragment_if_not_exists(shard).set_bit(0, shard)
+    view = _make_view(tmp_path)
+    barrier = threading.Barrier(2)
+    orig_open = Fragment.open
+
+    def rendezvous_open(self):
+        barrier.wait(timeout=5)  # both loaders must be here TOGETHER
+        orig_open(self)
+
+    monkeypatch.setattr(Fragment, "open", rendezvous_open)
+    threads = [
+        threading.Thread(target=view.create_fragment_if_not_exists, args=(s,))
+        for s in (0, 1)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(view.fragments) == [0, 1], (
+        "fragment opens serialized behind a view-wide lock"
+    )
+    assert all(view.fragment(s).contains(0, s) for s in (0, 1))
+
+
+# --------------------------------------------------- WAL fsync policy
+class _CountingHook:
+    """durable.py hook protocol that only counts ops per kind."""
+
+    def __init__(self):
+        self.counts: dict[str, int] = {}
+
+    def check(self, op, path):
+        self.counts[op] = self.counts.get(op, 0) + 1
+
+    def write_cap(self, op, path, nbytes):
+        return None
+
+    def torn(self, op, path):  # pragma: no cover — never armed
+        raise AssertionError("torn without a cap")
+
+
+@pytest.fixture
+def counting_hook():
+    # drain group-commit marks left by earlier tests (the WAL registry
+    # is process-global) so counts here cover ONLY this test's files
+    prev = durable.wal_fsync_mode()
+    durable.set_wal_fsync_mode("batch")
+    durable.ack_barrier()
+    durable.set_wal_fsync_mode(prev)
+    h = _CountingHook()
+    durable.install_fs_hook(h)
+    yield h
+    durable.install_fs_hook(None)
+
+
+def test_wal_always_fsyncs_every_append(tmp_path, wal_mode, counting_hook):
+    wal_mode("always")
+    p = str(tmp_path / "wal")
+    for i in range(3):
+        durable.append_wal(p, b"x" * 8)
+    assert counting_hook.counts.get("fsync", 0) == 3
+
+
+def test_wal_batch_group_fsyncs_at_ack_barrier(tmp_path, wal_mode, counting_hook):
+    wal_mode("batch")
+    p = str(tmp_path / "wal")
+    for i in range(5):
+        durable.append_wal(p, b"x" * 8)
+    assert counting_hook.counts.get("fsync", 0) == 0  # deferred
+    durable.ack_barrier()
+    assert counting_hook.counts.get("fsync", 0) == 1  # ONE for 5 appends
+    durable.ack_barrier()
+    assert counting_hook.counts.get("fsync", 0) == 1  # nothing dirty
+
+
+def test_wal_off_never_fsyncs(tmp_path, wal_mode, counting_hook):
+    wal_mode("off")
+    p = str(tmp_path / "wal")
+    for i in range(3):
+        durable.append_wal(p, b"x" * 8)
+    durable.ack_barrier()
+    assert counting_hook.counts.get("fsync", 0) == 0
+
+
+def test_wal_mode_validation():
+    with pytest.raises(ValueError):
+        durable.set_wal_fsync_mode("sometimes")
+
+
+def test_group_fsync_covers_multiple_files(tmp_path, wal_mode, counting_hook):
+    wal_mode("batch")
+    a, b = str(tmp_path / "a"), str(tmp_path / "b")
+    durable.append_wal(a, b"1")
+    durable.append_wal(b, b"2")
+    durable.append_wal(a, b"3")
+    durable.ack_barrier()
+    assert counting_hook.counts.get("fsync", 0) == 2  # one per dirty file
+    snap = durable.wal_snapshot()
+    assert snap["mode"] == "batch" and snap["dirtyFiles"] == 0
+
+
+def test_atomic_write_crash_preserves_old_content(tmp_path, fs_hook):
+    p = str(tmp_path / "meta.json")
+    durable.atomic_write_file(p, b"old")
+    fs_hook([{"op": "rename", "action": "crash", "path": "meta.json"}])
+    with pytest.raises(SimulatedCrash):
+        durable.atomic_write_file(p, b"new")
+    durable.install_fs_hook(None)
+    with open(p, "rb") as f:
+        assert f.read() == b"old"  # never a torn mix
+
+
+# ----------------------------------------------- server-level wiring
+def make_server(tmp_path, **kw) -> Server:
+    cfg = Config(
+        bind="127.0.0.1:0",
+        data_dir=str(tmp_path / "data"),
+        anti_entropy_interval=0,
+        **kw,
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(30)
+    return s
+
+
+def call(srv, method, path, body=None, headers=None):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = (
+        body
+        if isinstance(body, (bytes, type(None)))
+        else json.dumps(body).encode()
+    )
+    req = urllib.request.Request(url, data=data, method=method)
+    for k, v in (headers or {}).items():
+        req.add_header(k, v)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+def test_write_lane_429_past_compaction_debt(tmp_path):
+    srv = make_server(tmp_path, compaction_max_debt=1)
+    try:
+        # the debt feed is wired to THE holder's compactor
+        assert srv.http.compaction_debt == srv.holder.compactor.debt
+        call(srv, "POST", "/index/i")
+        call(srv, "POST", "/index/i/field/f")
+        ok, _ = call(
+            srv, "POST", "/index/i/field/f/import",
+            {"rowIDs": [0], "columnIDs": [1]},
+        )
+        assert ok == 200
+        # simulate a compactor that has fallen behind
+        srv.http.compaction_debt = lambda: 5
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            call(
+                srv, "POST", "/index/i/field/f/import",
+                {"rowIDs": [0], "columnIDs": [2]},
+            )
+        assert exc.value.code == 429
+        assert exc.value.headers.get("Retry-After")
+        # reads and control traffic keep flowing: the debt gates ONLY
+        # the write lane
+        ok, _ = call(srv, "POST", "/index/i/query", b"Count(Row(f=0))")
+        assert ok == 200
+        ok, _ = call(srv, "GET", "/status")
+        assert ok == 200
+        # debt drains → writes admitted again
+        srv.http.compaction_debt = lambda: 0
+        ok, _ = call(
+            srv, "POST", "/index/i/field/f/import",
+            {"rowIDs": [0], "columnIDs": [2]},
+        )
+        assert ok == 200
+    finally:
+        srv.close()
+
+
+def test_debug_vars_durability_snapshot(tmp_path):
+    srv = make_server(tmp_path)
+    try:
+        _, out = call(srv, "GET", "/debug/vars")
+        dur = out["durability"]
+        assert dur["wal"]["mode"] == "batch"
+        assert "pending" in dur["compaction"]
+        assert "workers" in dur["compaction"]
+        _, faults = call(srv, "GET", "/debug/faults")
+        assert "fs" in faults  # the FS fault layer reports its rule set
+    finally:
+        srv.close()
+
+
+def test_server_compacts_in_background_and_acks_durably(tmp_path, wal_mode):
+    srv = make_server(tmp_path, wal_fsync_mode="always")
+    try:
+        assert durable.wal_fsync_mode() == "always"  # config applied
+        call(srv, "POST", "/index/i")
+        call(srv, "POST", "/index/i/field/f")
+        frag_paths = []
+        for b in range(8):
+            ok, _ = call(
+                srv, "POST", "/index/i/field/f/import",
+                {"rowIDs": [0] * 4, "columnIDs": list(range(b * 4, b * 4 + 4))},
+            )
+            assert ok == 200
+            for v in srv.holder.index("i").field("f").views.values():
+                for frag in v.fragments.values():
+                    frag.max_op_n = 4
+                    frag_paths.append(frag.path)
+        assert srv.holder.compactor.wait_idle(10)
+        assert srv.holder.compactor.compacted >= 1
+    finally:
+        srv.close()
+    # a fresh holder (the restart) sees every acknowledged bit
+    h = Holder(str(tmp_path / "data"))
+    h.open()
+    try:
+        frag = h.index("i").field("f").view("standard").fragment(0)
+        assert all(frag.contains(0, c) for c in range(32))
+    finally:
+        h.close()
+
+
+class _FsyncPathsHook:
+    """durable.py hook protocol recording which paths get fsynced."""
+
+    def __init__(self):
+        self.fsyncs: list[str] = []
+
+    def check(self, op, path):
+        if op == "fsync":
+            self.fsyncs.append(path)
+
+    def write_cap(self, op, path, nbytes):
+        return None
+
+    def torn(self, op, path):  # pragma: no cover — never armed
+        raise AssertionError("torn without a cap")
+
+
+def _free_ports(n):
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()
+
+
+def test_cluster_write_query_acks_behind_the_barrier(tmp_path, wal_mode):
+    """A CLUSTERED write query's acknowledgement must sit behind the
+    same WAL barrier as the single-node path (docs/durability.md): in
+    batch mode, the coordinator's local write leg and the replica's
+    remote leg each group-fsync the dirtied ops logs before their
+    response leaves — cluster routing swaps the query router off
+    api.query, so the barrier has to live in the cluster paths too."""
+    wal_mode("batch")
+    ports = _free_ports(2)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(2):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            replica_n=2,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    try:
+        for s in servers:
+            s.cluster._heartbeat_once()
+        assert call(servers[0], "POST", "/index/i")[0] == 200
+        assert call(servers[0], "POST", "/index/i/field/f")[0] == 200
+        hook = _FsyncPathsHook()
+        durable.install_fs_hook(hook)
+        try:
+            st, _ = call(
+                servers[0], "POST", "/index/i/query", b"Set(1, f=2)"
+            )
+            assert st == 200
+        finally:
+            durable.install_fs_hook(None)
+        frag_fsyncs = [
+            p for p in hook.fsyncs
+            if "fragments" in p and os.path.basename(p).isdigit()
+        ]
+        assert frag_fsyncs, (
+            "clustered Set() acknowledged without fsyncing any fragment "
+            f"ops log (fsyncs seen: {hook.fsyncs})"
+        )
+    finally:
+        for s in servers:
+            s.close()
+
+
+# ------------------------------------------------- parallel cold start
+def _build_holder(path, n_fields=3, n_rows=4):
+    h = Holder(path)
+    h.open()
+    idx = h.create_index("i")
+    for fi in range(n_fields):
+        f = idx.create_field(f"f{fi}")
+        rows = np.repeat(np.arange(n_rows, dtype=np.uint64), 8)
+        cols = np.arange(rows.size, dtype=np.uint64) + fi
+        f.import_bulk(rows, cols)
+    h.close()
+
+
+def test_parallel_holder_load_matches_serial(tmp_path):
+    path = str(tmp_path / "h")
+    _build_holder(path)
+
+    def snapshot_of(h):
+        out = {}
+        for fname, f in sorted(h.index("i").fields.items()):
+            frag = f.view("standard").fragment(0)
+            if frag is None:
+                continue
+            out[fname] = sorted(frag.bitmap.values().tolist())
+        return out
+
+    serial = Holder(path, load_workers=1)
+    serial.open()
+    parallel = Holder(path, load_workers=8)
+    parallel.open()
+    try:
+        a, b = snapshot_of(serial), snapshot_of(parallel)
+        assert a == b and len(a) == 3 and all(v for v in a.values())
+    finally:
+        serial.close()
+        parallel.close()
+
+
+def test_parallel_load_surfaces_fragment_error(tmp_path, fs_hook):
+    path = str(tmp_path / "h")
+    _build_holder(path)
+    fs_hook([{"op": "truncate", "action": "eio"}])
+    # tear a fragment so the reopen path needs its (faulted) repair
+    frag_file = None
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            if fn == "0":
+                frag_file = os.path.join(root, fn)
+    assert frag_file
+    with open(frag_file, "r+b") as f:
+        f.truncate(os.path.getsize(frag_file) - 1)
+    h = Holder(path, load_workers=8)
+    with pytest.raises(OSError):
+        h.open()  # the pool join re-raises the first real I/O error
+
+
+# ----------------------------------------------- kill-9 recovery (slow)
+CHILD = REPO / "tests" / "_durability_child.py"
+
+KILL_POINTS = [
+    # mid-WAL-append: the record is cut short ON DISK, then SIGKILL —
+    # exactly what a power cut mid-write leaves
+    ("mid-oplog-append", {"op": "wal-append", "action": "torn",
+                          "cap_bytes": 6, "then": "kill",
+                          "path": "fragments/", "after": 120}),
+    # mid-snapshot-write: the compaction's tmp file is half-written
+    ("mid-snapshot-write", {"op": "snapshot-write", "action": "torn",
+                            "cap_bytes": 40, "then": "kill",
+                            "path": "fragments/", "after": 6}),
+    # pre-rename: tmp complete, never committed
+    ("pre-rename", {"op": "rename", "action": "kill",
+                    "path": "fragments/", "after": 4}),
+    # pre-dir-fsync: renamed but the directory entry not yet durable
+    ("pre-dir-fsync", {"op": "dirfsync", "action": "kill",
+                       "path": "fragments", "after": 4}),
+    # mid-compaction: death at the fold's first disk touch
+    ("mid-compaction", {"op": "snapshot-write", "action": "kill",
+                        "path": "fragments/", "after": 8}),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("point,rule", KILL_POINTS, ids=[p for p, _ in KILL_POINTS])
+def test_kill9_zero_acknowledged_writes_lost(tmp_path, point, rule):
+    """THE durability acceptance test: a child process ingests batches
+    (acknowledged only after the durability barrier), a seeded rule
+    SIGKILLs it at an exact write-protocol point, and the reopened
+    holder must hold every acknowledged batch."""
+    data_dir = str(tmp_path / "holder")
+    env = dict(os.environ, PILOSA_TPU_SHARD_WIDTH_EXP="16",
+               JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(CHILD), data_dir, json.dumps([rule]), "batch"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == -9, (
+        f"{point}: child must die by SIGKILL at the armed point "
+        f"(rc={proc.returncode})\n{proc.stdout}\n{proc.stderr}"
+    )
+    acked = [
+        int(line.split()[1])
+        for line in proc.stdout.splitlines()
+        if line.startswith("ACK ")
+    ]
+    assert acked, f"{point}: no batch was acknowledged before the kill"
+    sys.path.insert(0, str(REPO / "tests"))
+    try:
+        from _durability_child import batch_bits
+    finally:
+        sys.path.pop(0)
+    h = Holder(data_dir)
+    h.open()
+    try:
+        frag = h.index("i").field("f").view("standard").fragment(0)
+        assert frag is not None
+        assert not (frag.last_recovery or {}).get("quarantined", False)
+        lost = []
+        for b in acked:
+            rows, cols = batch_bits(b)
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                if not frag.contains(r, c):
+                    lost.append((b, r, c))
+        assert not lost, (
+            f"{point}: {len(lost)} acknowledged bits lost after SIGKILL "
+            f"(acked through batch {acked[-1]}): {lost[:5]}"
+        )
+    finally:
+        h.close()

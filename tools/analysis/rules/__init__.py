@@ -5,6 +5,7 @@ from tools.analysis.rules import (  # noqa: F401
     asyncpurity,
     banned,
     configdrift,
+    durability,
     locks,
     observability,
     parity,

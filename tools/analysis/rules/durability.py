@@ -1,0 +1,134 @@
+"""Durable write-protocol discipline (docs/durability.md).
+
+The durability PR's contract: every byte persisted beneath the holder
+path reaches disk through ``utils/durable.py`` — the ONE place that
+knows the crash-safe protocol (tmp write → fsync(file) → rename →
+fsync(parent dir), WAL appends with the acknowledgement fsync policy).
+A bare ``open(path, "w")`` or naked ``os.replace`` anywhere else is a
+write that can be lost or torn by a crash the chaos suite will never
+see, because the fault hooks live inside the sanctioned helpers.
+Enforced structurally:
+
+1. **no bare write-mode open() in the holder data layer** — files under
+   ``core/`` must not call ``open()`` with a write/append mode; they go
+   through ``durable.atomic_write_file`` / ``durable.append_wal`` /
+   ``durable.open_wal`` (which consult the FS fault hook and carry the
+   fsync discipline);
+2. **os.replace only inside utils/durable.py** — the rename is only
+   crash-durable when the parent directory is fsynced after it, and the
+   pairing lives in ``durable.replace_durable`` / ``atomic_write_file``
+   (best-effort writers pass ``durable=False`` explicitly — the waiver
+   is visible at the call site);
+3. **every os.replace in utils/durable.py pairs with a dir fsync** —
+   the function performing the rename must also call ``fsync_dir``; a
+   refactor that drops the fsync re-introduces the lost-rename crash
+   window PR 8 closed.
+
+Files are located by project-relative suffix so tests can run the rule
+against fixtures (``core/`` fixtures live under a ``core/`` dir) and
+mutated copies of the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, call_name, rule
+
+DURABLE = "utils/durable.py"
+
+# write/append file modes whose bytes belong to the durable protocol
+_WRITE_MODES = ("w", "a", "x", "+")
+
+
+def _is_durable(rel: str) -> bool:
+    return rel == DURABLE or rel.endswith("/" + DURABLE)
+
+
+def _in_core(rel: str) -> bool:
+    return rel.startswith("core/") or "/core/" in rel
+
+
+def _open_mode(node: ast.Call) -> str | None:
+    """The literal mode string of an ``open()`` call ('' when omitted,
+    None when dynamic — dynamic modes are flagged conservatively by the
+    caller only in core/)."""
+    mode: ast.expr | None = None
+    if len(node.args) >= 2:
+        mode = node.args[1]
+    else:
+        kw = next((k for k in node.keywords if k.arg == "mode"), None)
+        mode = kw.value if kw else None
+    if mode is None:
+        return ""
+    if isinstance(mode, ast.Constant) and isinstance(mode.value, str):
+        return mode.value
+    return None
+
+
+@rule(
+    "durability",
+    "holder-path writes go through utils/durable.py; every rename is "
+    "paired with a parent-dir fsync",
+)
+def check_durability(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    for f in project.files:
+        if f.tree is None or _is_durable(f.rel):
+            continue
+        in_core = _in_core(f.rel)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node.func)
+            if name == "os.replace":
+                out.append(
+                    Violation(
+                        "durability",
+                        f.rel,
+                        node.lineno,
+                        "naked os.replace — a rename is only crash-durable "
+                        "with a parent-dir fsync after it; use "
+                        "durable.replace_durable / durable.atomic_write_file "
+                        "(durable=False for best-effort caches)",
+                    )
+                )
+            elif name == "open" and in_core:
+                mode = _open_mode(node)
+                if mode is None or any(c in mode for c in _WRITE_MODES):
+                    out.append(
+                        Violation(
+                            "durability",
+                            f.rel,
+                            node.lineno,
+                            "bare write-mode open() beneath the holder path "
+                            "— persistent writes go through the sanctioned "
+                            "durable helpers (atomic_write_file / append_wal "
+                            "/ open_wal), which carry the fsync discipline "
+                            "and the FS fault hook",
+                        )
+                    )
+
+    # 3: inside the sanctioned module, rename ⇒ dir fsync, same function
+    dur = project.find(DURABLE)
+    if dur is not None and dur.tree is not None:
+        for fn in ast.walk(dur.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = [
+                call_name(c.func)
+                for c in ast.walk(fn)
+                if isinstance(c, ast.Call)
+            ]
+            if "os.replace" in calls and "fsync_dir" not in calls:
+                out.append(
+                    Violation(
+                        "durability",
+                        dur.rel,
+                        fn.lineno,
+                        f"{fn.name}() calls os.replace without a fsync_dir "
+                        "in the same function — the rename can be lost on "
+                        "crash (the committed file silently reverts)",
+                    )
+                )
+    return out
