@@ -1,4 +1,4 @@
-.PHONY: check fix test analyze bench-ingest
+.PHONY: check fix test analyze bench-ingest bench-residency
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -19,3 +19,9 @@ test:
 # exits non-zero when mixed read p95 breaks the 2x read-only gate
 bench-ingest:
 	PILOSA_BENCH_ALL_CHILD=ingest python bench_all.py
+
+# tiered compressed residency row (docs/device-residency.md): an index
+# whose uncompressed stack is >=4x the device budget, hot-set QPS vs the
+# forced-host baseline + compression ratio; exits non-zero below 1.0x
+bench-residency:
+	PILOSA_BENCH_ALL_CHILD=residency python bench_all.py

@@ -119,11 +119,33 @@ def stack_view_matrices(view, shards: list[int]) -> tuple[np.ndarray, int]:
 _OOB = np.int32(2**30)
 
 _budget_cache: list[int] = []
+# explicit override installed from config (device-stack-budget-bytes);
+# wins over the env var and the HBM probe
+_budget_override: list[int] = []
+
+
+def set_stack_budget(n: int | None) -> None:
+    """Install the configured device stack budget (Config field
+    ``device-stack-budget-bytes``; the server wires it at boot).  None
+    or 0 clears back to env/HBM resolution.  Always resets the memo so
+    tests and re-configuration see the change immediately."""
+    _budget_override.clear()
+    if n:
+        _budget_override.append(int(n))
+    _budget_cache.clear()
+
+
+def reset_stack_budget_cache() -> None:
+    """Drop the memoized resolution (tests re-resolve after changing
+    PILOSA_TPU_STACK_BUDGET; the old cache was append-only)."""
+    _budget_cache.clear()
 
 
 def _stack_budget() -> int:
     """See StackCache.STACK_BYTES_BUDGET. Cached after first resolution
     (device memory limits don't change mid-process)."""
+    if _budget_override:
+        return _budget_override[0]
     if _budget_cache:
         return _budget_cache[0]
     env = os.environ.get("PILOSA_TPU_STACK_BUDGET")
@@ -143,6 +165,15 @@ def _stack_budget() -> int:
             budget = 2 << 30
     _budget_cache.append(budget)
     return budget
+
+
+@jax.jit
+def _scatter_rows(store, idx, rows):
+    """Functional row scatter for the tiered container stores:
+    ``store[idx[k]] = rows[k]`` for dense [H,S,W], sparse [H,K] and run
+    [H,K,2] stores alike. _OOB padding indices drop. Not donated — a
+    query snapshot may still hold the previous array."""
+    return store.at[idx].set(rows, mode="drop")
 
 
 @jax.jit
@@ -184,12 +215,23 @@ class StackCache:
     def STACK_BYTES_BUDGET(self) -> int:  # noqa: N802 — historical name
         return _stack_budget()
 
-    def __init__(self, mesh_ctx=None):
+    # How over-budget fields serve resident rows (docs/device-residency.md):
+    # "tiered"  — per-row compressed containers (dense/sparse/run) with a
+    #             hot/cold LRU tier and touch-driven promotion (default);
+    # "slots"   — the legacy dense hot-row slot stack (tests pin it to
+    #             exercise that path; no compression, no cold tier).
+    RESIDENCY_MODE = "tiered"
+    MAX_TIERED_ENTRIES = 4  # count cap; the byte ledger is the real bound
+
+    def __init__(self, mesh_ctx=None, stats=None):
         from collections import OrderedDict
 
         self._cache: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._hot: "OrderedDict[tuple, dict]" = OrderedDict()
+        # tiered compressed residency entries (executor/residency.py)
+        self._tiered: "OrderedDict[tuple, Any]" = OrderedDict()
         self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
+        self.stats = stats  # optional StatsClient for residency metrics
         self._lock = threading.Lock()
         # shared byte ledger across BOTH caches: the budget is an
         # AGGREGATE resident cap, not just per-stack — a per-entry check
@@ -209,6 +251,13 @@ class StackCache:
         self.delta_updates = 0
         self.delta_rows_uploaded = 0
         self.hot_row_uploads = 0
+        # tiered residency counters (satellite: eviction/tier
+        # observability; /debug/vars deviceResidency reads these)
+        self.rows_promoted = 0
+        self.rows_demoted = 0
+        self.cold_uploads = 0
+        self.evictions = {"dense": 0, "hot": 0, "tiered": 0}
+        self._container_bytes = {"dense": 0, "sparse": 0, "run": 0}
 
     # ----------------------------------------------------- byte ledger
     # callers hold self._lock
@@ -220,9 +269,10 @@ class StackCache:
         self.resident_bytes -= self._bytes.pop(key, 0)
 
     def _evict_for(self, need: int, keep: tuple | None = None) -> None:
-        """Evict LRU entries (dense first, then hot) until ``need`` more
-        bytes fit under the budget. The entry being (re)built is exempt;
-        if nothing evictable remains the admit proceeds anyway — the
+        """Evict LRU entries (dense stacks first, then hot slot stacks,
+        then tiered container entries) until ``need`` more bytes fit
+        under the budget. The entry being (re)built is exempt; if
+        nothing evictable remains the admit proceeds anyway — the
         per-stack check already bounds any single entry."""
         budget = self.STACK_BYTES_BUDGET
         while (
@@ -232,12 +282,25 @@ class StackCache:
             if victim is not None:
                 del self._cache[victim]
                 self._forget(victim)
+                self._note_eviction("dense")
                 continue
             victim = next((k for k in self._hot if k != keep), None)
+            if victim is not None:
+                del self._hot[victim]
+                self._forget(victim)
+                self._note_eviction("hot")
+                continue
+            victim = next((k for k in self._tiered if k != keep), None)
             if victim is None:
                 break
-            del self._hot[victim]
-            self._forget(victim)
+            self._forget_tiered(victim, self._tiered.pop(victim))
+            self._note_eviction("tiered")
+
+    def _note_eviction(self, tier: str) -> None:
+        # caller holds self._lock
+        self.evictions[tier] = self.evictions.get(tier, 0) + 1
+        if self.stats is not None:
+            self.stats.count("stack_evictions_total", tags={"tier": tier})
 
     @staticmethod
     def _projected_rows(view, shards: list[int]) -> int:
@@ -406,6 +469,7 @@ class StackCache:
                 "hotRowUploads": self.hot_row_uploads,
                 "entries": len(self._cache),
                 "hotEntries": len(self._hot),
+                "tieredEntries": len(self._tiered),
                 "residentBytes": self.resident_bytes,
                 "budgetBytes": self.STACK_BYTES_BUDGET,
             }
@@ -416,6 +480,9 @@ class StackCache:
             self.resident_bytes = 0
             self._cache.clear()
             self._hot.clear()
+            self._tiered.clear()
+            self._container_bytes = {"dense": 0, "sparse": 0, "run": 0}
+            self._push_residency_gauges()
 
     # ----------------------------------------------------- hot-row stacks
     # High-cardinality fields (dense stack over STACK_BYTES_BUDGET) keep
@@ -576,6 +643,406 @@ class StackCache:
             self._upload_hot_rows(entry, view, shards, uploads)
             return entry["dev"], {r: slots[r] for r in need}
 
+    # ------------------------------------------- tiered compressed residency
+    # Over-budget fields in "tiered" mode keep a hot working set of rows
+    # resident in per-row COMPRESSED containers — dense words, sparse
+    # column ids, or run intervals (executor/residency.py chooses per
+    # row; ops/containers.py evaluates directly over the payloads).
+    # Cold rows live in the host roaring bitmaps: their first touch
+    # serves via a one-shot host-packed upload (host-served, merged
+    # exactly on device), repeated touches promote them into residency,
+    # and LRU slot reuse demotes the coldest resident row back to host.
+
+    def residency_mode(self) -> str:
+        # multi-host meshes serve over-budget fields through the legacy
+        # slot path: container payloads are packed from PROCESS-LOCAL
+        # fragments in local-position space, which cannot be declared a
+        # replicated global array (each process would hold different
+        # bits) — the [H, S, W] slot stack, by contrast, shards along S
+        # like every other stack
+        if self.mesh_ctx is not None and getattr(
+            self.mesh_ctx, "multihost", False
+        ):
+            return "slots"
+        return self.RESIDENCY_MODE
+
+    def is_over_budget(
+        self, idx: Index, field: Field, view_name: str, shards: list[int]
+    ) -> bool:
+        """Would this field's dense stack exceed the budget (i.e. do its
+        rows serve through the tiered/hot layer)?  O(S) metadata scan,
+        no allocation — the router's residency probe."""
+        view = field.view(view_name)
+        r_pad = self._projected_rows(view, shards)
+        need = len(shards) * r_pad * WORDS_PER_SHARD * 4
+        return need > self.STACK_BYTES_BUDGET
+
+    def _pack_plane(self, view, shards: list[int], row_id) -> np.ndarray:
+        """Host-packed [S, W] plane of one row, straight from fragments."""
+        out = np.zeros((len(shards), WORDS_PER_SHARD), dtype=np.uint32)
+        if view is None or row_id is None or row_id < 0:
+            return out
+        for i, s in enumerate(shards):
+            frag = view.fragment(s)
+            if frag is not None:
+                out[i] = frag.row_packed(row_id)
+        return out
+
+    def _tiered_entry(self, idx: Index, field: Field, view_name: str, shards):
+        """(key, entry, view), versions reconciled. Caller holds _lock.
+        Stale resident rows are DROPPED (not re-uploaded): a write may
+        change a row's container class, so the next touch re-chooses and
+        re-packs; touch counts survive, so a hot row re-promotes on its
+        very next query."""
+        from pilosa_tpu.executor.residency import TieredEntry
+
+        view = field.view(view_name)
+        key = ("tier", idx.name, field.name, view_name, tuple(shards))
+        view_ver = view.version if view is not None else None
+        entry = self._tiered.get(key)
+        if entry is None:
+            entry = TieredEntry(len(shards), self.STACK_BYTES_BUDGET)
+            self._tiered[key] = entry
+            while len(self._tiered) > self.MAX_TIERED_ENTRIES:
+                victim = next(k for k in self._tiered if k != key)
+                self._forget_tiered(victim, self._tiered.pop(victim))
+                self._note_eviction("tiered")
+        # track the live budget: set_stack_budget() reconfiguration must
+        # size NEW stores from the current value (existing stores keep
+        # their allocation — the shared ledger evicts them under
+        # pressure like anything else)
+        entry.budget = self.STACK_BYTES_BUDGET
+        self._tiered.move_to_end(key)
+        if view_ver is not None and entry.view_ver == view_ver:
+            return key, entry, view
+        versions = tuple(self._frag_token(view, s) for s in shards)
+        if entry.versions != versions:
+            stale: set[int] | None = set()
+            if entry.versions is not None:
+                for i, s in enumerate(shards):
+                    old_uid, old_ver = entry.versions[i]
+                    new_uid, _nv = versions[i]
+                    if (old_uid, old_ver) == versions[i]:
+                        continue
+                    frag = view.fragment(s) if view else None
+                    if frag is None or old_uid != new_uid:
+                        stale = None
+                        break
+                    dirty = frag.dirty_rows_since(old_ver)
+                    if dirty is None:
+                        stale = None
+                        break
+                    stale |= dirty
+            else:
+                stale = None
+            if stale is None:
+                entry.clear()
+            else:
+                rows_dropped = [
+                    r
+                    for r in stale
+                    if any(r in st["slots"] for st in entry.stores.values())
+                ]
+                self.rows_demoted += len(rows_dropped)
+                entry.drop_rows(stale)
+            entry.versions = versions
+        entry.view_ver = view_ver
+        return key, entry, view
+
+    def _tiered_store(self, entry, kind: str, key: tuple) -> dict:
+        """Get-or-create one kind's fixed-capacity device store. Caller
+        holds _lock; creation charges the byte ledger (evicting LRU
+        entries first) and the per-container gauges."""
+        from pilosa_tpu.executor.residency import RUN_MAX_INTERVALS, SPARSE_MAX_IDS
+
+        st = entry.stores.get(kind)
+        if st is not None:
+            return st
+        h, _k = entry.capacity(kind, entry.n_shards * WORDS_PER_SHARD)
+        if kind == "dense":
+            host = np.zeros(
+                (h, entry.n_shards, WORDS_PER_SHARD), dtype=np.uint32
+            )
+        elif kind == "sparse":
+            host = np.full((h, SPARSE_MAX_IDS), -1, dtype=np.int32)
+        elif kind == "run":
+            host = np.zeros((h, RUN_MAX_INTERVALS, 2), dtype=np.int32)
+        else:
+            raise ValueError(f"unknown container kind {kind!r}")
+        nbytes = int(host.nbytes)
+        self._evict_for(nbytes, keep=key)
+        if self.mesh_ctx is not None:
+            dev = (
+                self.mesh_ctx.place_stack(host)
+                if kind == "dense"
+                else self.mesh_ctx.place_block(host)
+            )
+        else:
+            dev = jnp.asarray(host)
+        from collections import OrderedDict
+
+        st = {
+            "dev": dev,
+            "slots": OrderedDict(),
+            "free": [],
+            "alloc": 0,
+            "h": h,
+            "nbytes": nbytes,
+        }
+        entry.stores[kind] = st
+        self._account(key, self._bytes.get(key, 0) + nbytes)
+        self._container_bytes[kind] += nbytes
+        self._push_residency_gauges()
+        return st
+
+    def _forget_tiered(self, key: tuple, entry) -> None:
+        # caller holds self._lock
+        for kind, st in entry.stores.items():
+            self._container_bytes[kind] -= st["nbytes"]
+        self._forget(key)
+        self._push_residency_gauges()
+
+    def _push_residency_gauges(self) -> None:
+        if self.stats is None:
+            return
+        for kind, v in self._container_bytes.items():
+            self.stats.gauge(
+                "residency_bytes", v, tags={"container": kind}
+            )
+
+    def tiered_plan(
+        self,
+        idx: Index,
+        field: Field,
+        view_name: str,
+        shards: list[int],
+        row_id: int,
+    ) -> tuple[str, str]:
+        """Plan-time residency decision for one row leaf →
+        ``(container_kind, action)`` with action one of:
+
+        - "resident" — already on device; the batch snapshot will bump it;
+        - "promote"  — touch count reached the threshold; the batch will
+          pack + upload it into its container store (rows_promoted);
+        - "cold"     — below the threshold; serve via a one-shot
+          host-packed plane upload, no residency churn.
+
+        The chooser memoizes per (row, fragment versions); a miss costs
+        one host row pack + an O(words) popcount scan."""
+        from pilosa_tpu.executor import residency
+
+        with self._lock:
+            key, entry, view = self._tiered_entry(idx, field, view_name, shards)
+            if row_id is None or row_id < 0:
+                return "sparse", "cold"  # unknown key ⇒ all-zero plane
+            kind = entry.kinds.get(row_id)
+            if kind is not None:
+                # LRU, not FIFO: without the bump, a constantly-queried
+                # resident row's kind memo would age out behind one-shot
+                # cold rows, making tiered_resident report it cold (and
+                # re-analyzing its whole plane under the lock each plan)
+                entry.kinds.move_to_end(row_id)
+            else:
+                plane = self._pack_plane(view, shards, row_id)
+                nbits, nruns = residency.analyze_plane(plane)
+                kind = residency.choose_container(
+                    nbits, nruns, len(shards) * WORDS_PER_SHARD
+                )
+                entry.kinds[row_id] = kind
+                while len(entry.kinds) > residency.MAX_TOUCH_ROWS:
+                    entry.kinds.popitem(last=False)
+            touches = entry.note_touch(row_id)
+            if entry.resident(row_id, kind):
+                return kind, "resident"
+            if touches >= residency.PROMOTE_TOUCHES:
+                return kind, "promote"
+            return kind, "cold"
+
+    def cold_plane(
+        self, idx: Index, field: Field, view_name: str, shards, row_id: int
+    ):
+        """One-shot device upload of a host-packed row plane — the
+        pre-promotion cold service (the host serves the row, the device
+        program merges it exactly with resident-compressed rows)."""
+        view = field.view(view_name)
+        plane = self._pack_plane(view, shards, row_id)
+        with self._lock:
+            self.cold_uploads += 1
+        if self.mesh_ctx is not None:
+            return self.mesh_ctx.place_rows(plane)
+        return jnp.asarray(plane)
+
+    def tiered_batch(
+        self,
+        idx: Index,
+        field: Field,
+        view_name: str,
+        shards: list[int],
+        needs: "list[tuple[int, str]]",
+    ):
+        """Atomically ensure every (row, kind) pair is resident and
+        return ``({kind: dev_store}, {row: slot})`` captured in one
+        critical section — the same immutable-snapshot contract as
+        hot_batch (functional scatters swap arrays, so a compiled
+        program can never read a reassigned slot)."""
+        from pilosa_tpu.executor import residency
+
+        with self._lock:
+            key, entry, view = self._tiered_entry(idx, field, view_name, shards)
+            uniq = list(dict.fromkeys((r, k) for r, k in needs if r >= 0))
+            by_kind: dict[str, list[int]] = {}
+            for r, k in uniq:
+                by_kind.setdefault(k, []).append(r)
+            stores = {
+                k: self._tiered_store(entry, k, key) for k in by_kind
+            }
+            for k, rows in by_kind.items():
+                if len(rows) > stores[k]["h"]:
+                    # atomic-batch contract: a query needing more rows of
+                    # one container kind than its store holds fails
+                    # EXPLICITLY — never a silently evicted slot mid-query
+                    raise StackOverBudget(
+                        f"{field.name} ({k} container store, "
+                        f"{stores[k]['h']} slots)",
+                        len(rows),
+                        len(rows) * len(shards) * WORDS_PER_SHARD * 4,
+                        self.STACK_BYTES_BUDGET,
+                    )
+            # bump resident batch members first so LRU reuse never
+            # demotes one member of this batch to admit another
+            for k, rows in by_kind.items():
+                slots = stores[k]["slots"]
+                for r in rows:
+                    if r in slots:
+                        slots.move_to_end(r)
+            slot_map: dict[int, int] = {}
+            for k, rows in by_kind.items():
+                st = stores[k]
+                missing = [r for r in rows if r not in st["slots"]]
+                for r in rows:
+                    if r in st["slots"]:
+                        slot_map[r] = st["slots"][r]
+                # pack + validate BEFORE any slot mutation: a payload
+                # that no longer fits its planned kind (a racing write
+                # changed the row's class) must fail with the slot maps
+                # untouched, or later queries would read the assigned
+                # but never-written slot as resident zeros
+                payloads = {
+                    r: self._pack_payload(k, st, view, shards, r)
+                    for r in missing
+                }
+                uploads: list[tuple[int, int]] = []
+                for r in missing:
+                    if st["free"]:
+                        slot = st["free"].pop()
+                    elif st["alloc"] < st["h"]:
+                        slot = st["alloc"]
+                        st["alloc"] += 1
+                    else:
+                        demoted, slot = st["slots"].popitem(last=False)
+                        entry.kinds.pop(demoted, None)
+                        self.rows_demoted += 1
+                        if self.stats is not None:
+                            self.stats.count("rows_demoted")
+                    st["slots"][r] = slot
+                    slot_map[r] = slot
+                    uploads.append((r, slot))
+                if uploads:
+                    self._upload_tiered_rows(st, k, payloads, uploads)
+                    self.rows_promoted += len(uploads)
+                    self.hot_row_uploads += len(uploads)
+                    if self.stats is not None:
+                        self.stats.count("rows_promoted", len(uploads))
+            return {k: st["dev"] for k, st in stores.items()}, slot_map
+
+    def _pack_payload(self, kind: str, st: dict, view, shards, row_id: int):
+        """Pack one row for its planned container store, validating the
+        fit (caller holds _lock and has not yet assigned a slot)."""
+        from pilosa_tpu.executor import residency
+
+        payload = residency.pack_container(
+            kind, self._pack_plane(view, shards, row_id)
+        )
+        if kind != "dense" and payload.shape[0] > st["dev"].shape[1]:
+            raise StackOverBudget(
+                f"row {row_id} no longer fits its planned {kind!r} "
+                "container (changed class mid-plan)",
+                1,
+                int(payload.nbytes),
+                self.STACK_BYTES_BUDGET,
+            )
+        return payload
+
+    def _upload_tiered_rows(
+        self, st: dict, kind: str, payloads: dict, uploads
+    ) -> None:
+        """Scatter pre-packed, pre-validated payloads into one kind
+        store (one functional scatter per batch, padded to pow2 so XLA
+        retraces stay rare). Caller holds _lock."""
+        k_pad = 1 << (len(uploads) - 1).bit_length()
+        idx_arr = np.full(k_pad, _OOB, dtype=np.int32)
+        rows_arr = np.zeros((k_pad,) + st["dev"].shape[1:], st["dev"].dtype)
+        if kind == "sparse":
+            rows_arr[:] = -1
+        for j, (row_id, slot) in enumerate(uploads):
+            payload = payloads[row_id]
+            if kind == "dense":
+                rows_arr[j] = payload
+            else:
+                rows_arr[j, : payload.shape[0]] = payload
+            idx_arr[j] = slot
+        new_dev = _scatter_rows(st["dev"], idx_arr, rows_arr)
+        if new_dev.sharding != st["dev"].sharding:
+            new_dev = jax.device_put(new_dev, st["dev"].sharding)
+        st["dev"] = new_dev
+
+    def tiered_resident(
+        self, idx: Index, field: Field, view_name: str, shards, row_id: int
+    ) -> bool:
+        """Cheap residency probe (router cost model) — never creates
+        entries, packs planes, or bumps touch counts."""
+        key = ("tier", idx.name, field.name, view_name, tuple(shards))
+        with self._lock:
+            entry = self._tiered.get(key)
+            if entry is None:
+                return False
+            kind = entry.kinds.get(row_id)
+            if kind is None:
+                return False
+            return entry.resident(row_id, kind)
+
+    def residency_snapshot(self) -> dict:
+        """/debug/vars ``deviceResidency`` section + the ?profile=true
+        residency block (owns the field names, like stats_snapshot)."""
+        with self._lock:
+            per_entry = []
+            for key, entry in self._tiered.items():
+                per_entry.append(
+                    {
+                        "field": key[2],
+                        "view": key[3],
+                        "shards": len(key[4]),
+                        "rows": {
+                            k: len(st["slots"])
+                            for k, st in entry.stores.items()
+                        },
+                    }
+                )
+            return {
+                "mode": self.RESIDENCY_MODE,
+                "entries": len(self._tiered),
+                "residentRows": sum(
+                    e.resident_rows() for e in self._tiered.values()
+                ),
+                "rowsPromoted": self.rows_promoted,
+                "rowsDemoted": self.rows_demoted,
+                "coldUploads": self.cold_uploads,
+                "evictions": dict(self.evictions),
+                "bytesByContainer": dict(self._container_bytes),
+                "budgetBytes": self.STACK_BYTES_BUDGET,
+                "tiers": per_entry,
+            }
+
 
 # ------------------------------------------------------------------ plans
 class _Planner:
@@ -605,6 +1072,13 @@ class _Planner:
         # atomic (dev, slots) snapshot at materialize time
         self._hot_needs: dict[tuple, tuple[Field, str, list[int]]] = {}
         self._hot_resolved: dict[tuple, tuple] = {}
+        # tiered-mode needs: (row, container kind) pairs per field,
+        # resolved via ONE atomic tiered_batch snapshot each
+        self._tiered_needs: dict[tuple, tuple[Field, str, list]] = {}
+        self._tiered_resolved: dict[tuple, tuple] = {}
+        # (leaf structure key, count closure) for sparse/run leaves —
+        # Count(Row) over a compressed row skips the plane entirely
+        self.direct_counts: list[tuple[str, Callable]] = []
 
     def _add_array(self, key: tuple, build: Callable[[], Any]) -> int:
         i = self._array_keys.get(key)
@@ -622,6 +1096,10 @@ class _Planner:
         for fkey, (field, view_name, rows) in self._hot_needs.items():
             self._hot_resolved[fkey] = self.stacks.hot_batch(
                 self.idx, field, view_name, self.shards, rows
+            )
+        for fkey, (field, view_name, needs) in self._tiered_needs.items():
+            self._tiered_resolved[fkey] = self.stacks.tiered_batch(
+                self.idx, field, view_name, self.shards, needs
             )
         return [b() for b in self._builders]
 
@@ -654,6 +1132,8 @@ class _Planner:
             si = self._add_scalar(row_id)
             mode = "m"
         except StackOverBudget:
+            if self.stacks.residency_mode() != "slots":
+                return self._tiered_leaf(field, view_name, row_id)
             fkey = (field.name, view_name)
             need = self._hot_needs.setdefault(fkey, (field, view_name, []))
             if row_id >= 0:
@@ -685,6 +1165,103 @@ class _Planner:
 
         return run, f"row({mode}:{field.name}/{view_name})"
 
+    def _tiered_leaf(self, field: Field, view_name: str, row_id: int):
+        """Row leaf of an over-budget field in tiered residency mode
+        (docs/device-residency.md): the closure decodes the row's
+        COMPRESSED container inside the consuming program — the kind is
+        static (it is part of the structure key, so each kind combination
+        compiles once) and the traced scalar is the container-store slot.
+        Cold (pre-promotion) rows serve via a one-shot host-packed plane
+        input instead — host-served, merged exactly on device."""
+        kind, action = self.stacks.tiered_plan(
+            self.idx, field, view_name, self.shards, row_id
+        )
+        n_s, n_w = len(self.shards), WORDS_PER_SHARD
+        if action == "cold":
+            ai = self._add_array(
+                ("cold", field.name, view_name, row_id),
+                lambda: self.stacks.cold_plane(
+                    self.idx, field, view_name, self.shards, row_id
+                ),
+            )
+            # the array ORDINAL must be part of the structure key: cold
+            # arrays are per-row inputs (unlike the shared dense/tiered
+            # stores), so Union(Row(7), Row(7)) — one deduped input —
+            # and Union(Row(8), Row(9)) — two — are different program
+            # structures that a row-blind key would alias
+            return (
+                lambda arrays, scalars: arrays[ai]
+            ), f"row(cold{ai}:{field.name}/{view_name})"
+        fkey = (field.name, view_name)
+        need = self._tiered_needs.setdefault(fkey, (field, view_name, []))
+        need[2].append((row_id, kind))
+        ai = self._add_array(
+            ("tier", kind) + fkey,
+            lambda: self._tiered_resolved[fkey][0][kind],
+        )
+        self.scalars.append(
+            lambda: self._tiered_resolved[fkey][1].get(row_id, -1)
+        )
+        si = len(self.scalars) - 1
+        skey = f"row(tier-{kind}:{field.name}/{view_name})"
+
+        def gather(arrays, scalars):
+            st = arrays[ai]
+            slot = scalars[si]
+            s = jnp.clip(slot, 0, st.shape[0] - 1)
+            payload = jax.lax.dynamic_index_in_dim(
+                st, s, axis=0, keepdims=False
+            )
+            return payload, slot >= 0
+
+        if kind == "dense":
+
+            def run(arrays, scalars):
+                plane, valid = gather(arrays, scalars)
+                return jnp.where(valid, plane, jnp.uint32(0))
+
+        elif kind == "sparse":
+
+            def run(arrays, scalars):
+                ids, valid = gather(arrays, scalars)
+                ids = jnp.where(valid, ids, jnp.int32(-1))
+                return ops.containers.sparse_plane(ids, n_s, n_w)
+
+            self.direct_counts.append(
+                (
+                    skey,
+                    lambda arrays, scalars: ops.containers.sparse_count(
+                        jnp.where(
+                            gather(arrays, scalars)[1],
+                            gather(arrays, scalars)[0],
+                            jnp.int32(-1),
+                        )
+                    ),
+                )
+            )
+        elif kind == "run":
+
+            def run(arrays, scalars):
+                runs, valid = gather(arrays, scalars)
+                runs = jnp.where(valid, runs, jnp.int32(0))
+                return ops.containers.run_plane(runs, n_s, n_w)
+
+            self.direct_counts.append(
+                (
+                    skey,
+                    lambda arrays, scalars: ops.containers.run_count(
+                        jnp.where(
+                            gather(arrays, scalars)[1],
+                            gather(arrays, scalars)[0],
+                            jnp.int32(0),
+                        )
+                    ),
+                )
+            )
+        else:
+            raise PlanError(f"unknown container kind {kind!r}")
+        return run, skey
+
     def _existence(self):
         ef = self.idx.field(EXISTENCE_FIELD)
         if not self.idx.options.track_existence:
@@ -699,12 +1276,34 @@ class _Planner:
         return self._matrix_leaf(ef, VIEW_STANDARD, 0)
 
     def _bsi(self, field: Field):
-        """closure → uint32[D, S, W] bit-slice block (row-major stack)."""
+        """closure → uint32[D, S, W] bit-slice block (row-major stack).
+
+        Over-budget BSI stacks (huge shard lists) serve through the
+        tiered residency layer in tiered mode: each slice row is its own
+        container leaf — sign/existence slices tend to pack as runs,
+        high-significance slices as sparse ids — and the closure stacks
+        the decoded planes into the [D, S, W] block the BSI kernels
+        expect (a transient inside the program, never a resident copy)."""
+        need = BSI_OFFSET + field.bit_depth
+        try:
+            self.stacks.matrix(self.idx, field, VIEW_BSI, self.shards)
+        except StackOverBudget:
+            if self.stacks.residency_mode() == "slots":
+                raise
+            subs = [
+                self._matrix_leaf(field, VIEW_BSI, d) for d in range(need)
+            ]
+            fns = [s[0] for s in subs]
+            keys = ",".join(s[1] for s in subs)
+
+            def run_tiered(arrays, scalars):
+                return jnp.stack([fn(arrays, scalars) for fn in fns])
+
+            return run_tiered, f"bsitier({field.name}:{keys})"
         ai = self._add_array(
             ("bsi", field.name),
             lambda: self.stacks.matrix(self.idx, field, VIEW_BSI, self.shards)[0],
         )
-        need = BSI_OFFSET + field.bit_depth
 
         def run(arrays, scalars):
             m = arrays[ai]
@@ -885,8 +1484,8 @@ class QueryCompiler:
     and differ only in their inputs.
     """
 
-    def __init__(self, mesh_ctx=None):
-        self.stacks = StackCache(mesh_ctx)
+    def __init__(self, mesh_ctx=None, stats=None):
+        self.stacks = StackCache(mesh_ctx, stats=stats)
         self.mesh_ctx = mesh_ctx
         self._programs: dict[tuple, Callable] = {}
         self._ones: dict[int, Any] = {}
@@ -1039,11 +1638,26 @@ class QueryCompiler:
 
     def count_async(self, idx: Index, call: Call, shards: list[int]):
         """Device int64 scalar (not synced) — lets callers pipeline many
-        queries before paying the device→host readback latency."""
+        queries before paying the device→host readback latency.
+
+        When the whole tree is ONE sparse/run container leaf (tiered
+        residency), the count reads the compressed payload directly —
+        O(payload) values, no [S, W] plane even transiently."""
         planner, run, skey = self._plan(idx, call, shards)
-        key = (idx.name, len(shards), skey, "count")
+        direct = None
+        if len(planner.direct_counts) == 1 and planner.direct_counts[0][0] == skey:
+            direct = planner.direct_counts[0][1]
+        key = (
+            idx.name,
+            len(shards),
+            skey,
+            "count-direct" if direct is not None else "count",
+        )
 
         def build():
+            if direct is not None:
+                return jax.jit(direct)
+
             @jax.jit
             def prog(arrays, scalars):
                 words = run(arrays, scalars)
@@ -1052,6 +1666,20 @@ class QueryCompiler:
             return prog
 
         prog = self.program(key, build)
+        arrays = planner.materialize()
+        return self.call_program(
+            key, prog, arrays, self.device_scalars(planner.scalar_values())
+        )
+
+    def tiered_bsi_block(self, idx: Index, field: Field, shards: list[int]):
+        """[D, S, W] bit-slice block of an over-budget int field,
+        assembled on device from tiered compressed slice rows (the
+        executor's aggregate paths feed it to their Sum/Min/Max/TopN
+        programs; the block is a program OUTPUT, not a resident stack)."""
+        planner = _Planner(idx, shards, self.stacks)
+        run, skey = planner._bsi(field)
+        key = (idx.name, len(shards), skey, "bsi_block")
+        prog = self.program(key, lambda: jax.jit(run))
         arrays = planner.materialize()
         return self.call_program(
             key, prog, arrays, self.device_scalars(planner.scalar_values())

@@ -22,6 +22,7 @@ Redesigned for TPU:
 from __future__ import annotations
 
 import os
+import threading
 import time
 from datetime import datetime
 from typing import Any, Callable
@@ -243,7 +244,7 @@ class Executor:
     ):
         self.holder = holder
         self.stats = stats  # optional StatsClient for per-call histograms
-        self.compiler = QueryCompiler(mesh_ctx)
+        self.compiler = QueryCompiler(mesh_ctx, stats=stats)
         # per-call host/device routing (executor/router.py). Passing an
         # existing router preserves its calibration across executor
         # rebuilds (the server's mesh re-attach swaps the Executor but
@@ -262,6 +263,61 @@ class Executor:
             if self.compiler.mesh_engine is not None
             else 1
         )
+        # per-query-string route cache: the expensive half of routing is
+        # building the decision INPUTS (structural repr for the memo
+        # key, the work estimate's tree walk, the residency cold-row
+        # probe) — all re-derived per request even though decisions are
+        # stable. Entries revalidate every _ROUTE_CACHE_HITS hits, so
+        # calibration drift, data growth, and tier promotion re-route
+        # within a bounded number of queries (see _routes_for for why
+        # the drift generation is deliberately NOT part of the key).
+        from collections import OrderedDict
+
+        self._route_cache: "OrderedDict[tuple, list]" = OrderedDict()
+        # OrderedDict's relink on move_to_end/popitem is not safe under
+        # concurrent HTTP worker threads; the critical section is a few
+        # dict ops, so one uncontended lock costs ~nothing per query
+        self._route_cache_lock = threading.Lock()
+
+    _ROUTE_CACHE_HITS = 64
+    _ROUTE_CACHE_MAX = 512
+
+    def _routes_for(
+        self,
+        idx: Index,
+        index_name: str,
+        query,
+        calls: "list[Call]",
+        shards: list[int] | None,
+    ) -> "list[tuple[str | None, int]]":
+        """(route, work) per call, via the revalidating cache when the
+        query arrived as a raw string (the serving hot path)."""
+        if not isinstance(query, str):
+            return [self._route(idx, c, shards) for c in calls]
+        # deliberately NOT keyed on the router's drift generation: the
+        # bounded hit count IS the staleness limit — calibration drift
+        # re-routes within _ROUTE_CACHE_HITS queries, while keying on
+        # the generation would invalidate the whole cache on every EWMA
+        # wiggle and hand the hot path the full probe cost back
+        key = (
+            index_name,
+            query,
+            tuple(shards) if shards is not None else None,
+            self.router.mode,
+        )
+        with self._route_cache_lock:
+            ent = self._route_cache.get(key)
+            if ent is not None and ent[0] > 0 and len(ent[1]) == len(calls):
+                ent[0] -= 1
+                self._route_cache.move_to_end(key)
+                return ent[1]
+        routes = [self._route(idx, c, shards) for c in calls]
+        with self._route_cache_lock:
+            self._route_cache[key] = [self._ROUTE_CACHE_HITS, routes]
+            self._route_cache.move_to_end(key)
+            while len(self._route_cache) > self._ROUTE_CACHE_MAX:
+                self._route_cache.popitem(last=False)
+        return routes
 
     # ------------------------------------------------------------ entry
     def execute(
@@ -309,12 +365,12 @@ class Executor:
         calls = parse(query) if isinstance(query, str) else query
         prof = tracing.current_profile()
         prof_shards: list[int] | None = None
+        if routes is None:
+            routes = self._routes_for(idx, index_name, query, calls, shards)
         results = []
         for i, c in enumerate(calls):
             t0 = time.perf_counter()
-            route, work = (
-                routes[i] if routes is not None else self._route(idx, c, shards)
-            )
+            route, work = routes[i]
             with GLOBAL_TRACER.span(f"executor.{c.name}", index=index_name):
                 results.append(
                     self._execute_call(idx, c, shards, lazy=True, route=route)
@@ -341,6 +397,11 @@ class Executor:
                 if prof_shards is None:
                     prof_shards = self._shards(idx, shards)
                 prof.add_call(c.name, elapsed, prof_shards, route=route)
+        if prof is not None and self.compiler.stacks._tiered:
+            # residency block in ?profile=true: which container tiers
+            # served this query's over-budget fields and the promotion /
+            # demotion counters at the time it ran
+            prof.residency = self.compiler.stacks.residency_snapshot()
         return results
 
     def fetch(self, pending: "list[_Pending]") -> float:
@@ -399,7 +460,18 @@ class Executor:
             return "host", 0
         n = len(sh) if sh is not None else max(1, len(idx.available_shards()))
         work = estimate_words(idx, c, n)
-        mesh_ok = self._mesh_ok(c, n)
+        if self.router.mode in ("host", "device"):
+            # pinned modes never consult mesh eligibility or the cold-row
+            # cost term — skip the residency walk on their hot path
+            tiered, cold_words = False, 0
+        else:
+            tiered, cold_words = self._residency_info(idx, c, sh)
+        # tiered container stores hold payloads in GLOBAL position space,
+        # which a shard_map program's per-device block cannot decode —
+        # tiered-touched trees stay on the single-program device path
+        # (the stores themselves are mesh-placed, so SPMD reads of the
+        # decoded planes keep working)
+        mesh_ok = self._mesh_ok(c, n) and not tiered
         if self.router.mode != "auto":
             mode = self.router.mode
             if mode == "mesh" and not mesh_ok:
@@ -411,9 +483,108 @@ class Executor:
                     self.compiler.mesh_engine.note_fallback()
             return mode, work
         return (
-            self.router.decide((idx.name, n, repr(c)), work, mesh_ok=mesh_ok),
+            self.router.decide(
+                (idx.name, n, repr(c)),
+                work,
+                mesh_ok=mesh_ok,
+                device_extra_words=cold_words,
+            ),
             work,
         )
+
+    def _residency_info(
+        self, idx: Index, call: Call, shards: list[int] | None
+    ) -> tuple[bool, int]:
+        """(touches_tiered_field, cold_upload_words) for one call tree.
+
+        Every COLD row of a tiered (over-budget) field costs the device
+        path roughly one host-packed [S, W] plane upload — the router
+        charges that against the device route so a one-shot scan of a
+        cold working set serves host-side, while a re-touched (promoted)
+        set routes back to the device.  Promotion itself is driven by
+        the touch counts the tiered layer keeps; this probe never
+        mutates them."""
+        stacks = self.compiler.stacks
+        if stacks.residency_mode() == "slots":
+            return False, 0
+        shard_list = self._shards(idx, shards)
+        unit = len(shard_list) * WORDS_PER_SHARD
+        over_budget: dict[tuple, bool] = {}
+
+        def over(field: Field, view_name: str) -> bool:
+            k = (field.name, view_name)
+            got = over_budget.get(k)
+            if got is None:
+                got = stacks.is_over_budget(idx, field, view_name, shard_list)
+                over_budget[k] = got
+            return got
+
+        tiered = False
+        cold = 0
+
+        def leaf(field: Field, view_name: str, row_id) -> None:
+            nonlocal tiered, cold
+            if not over(field, view_name):
+                return
+            tiered = True
+            if not stacks.tiered_resident(
+                idx, field, view_name, shard_list, row_id
+            ):
+                cold += unit
+
+        def walk(c: Call) -> None:
+            nonlocal tiered, cold
+            if c.name in ("Row", "Range"):
+                cond = c.condition()
+                if cond is not None:
+                    f = idx.field(cond[0])
+                    if f is not None and over(f, VIEW_BSI):
+                        tiered = True
+                        need = BSI_OFFSET + f.bit_depth
+                        for d in range(need):
+                            if not stacks.tiered_resident(
+                                idx, f, VIEW_BSI, shard_list, d
+                            ):
+                                cold += unit
+                    return
+                fa = c.field_arg()
+                if fa is not None:
+                    f = idx.field(fa[0])
+                    if f is not None:
+                        row = fa[1]
+                        if isinstance(row, bool):
+                            row = int(row)
+                        if isinstance(row, int):
+                            leaf(f, VIEW_STANDARD, row)
+                return
+            if c.name in ("Sum", "Min", "Max"):
+                # the aggregate's own BSI block is read too — an
+                # over-budget one serves via tiered slice containers,
+                # which the mesh programs cannot consume
+                fname = c.arg("field") or (
+                    c.pos_args[0] if c.pos_args else None
+                )
+                f = idx.field(fname) if isinstance(fname, str) else None
+                if f is not None and f.options.field_type == FIELD_INT and over(
+                    f, VIEW_BSI
+                ):
+                    tiered = True
+                    for d in range(BSI_OFFSET + f.bit_depth):
+                        if not stacks.tiered_resident(
+                            idx, f, VIEW_BSI, shard_list, d
+                        ):
+                            cold += unit
+            for ch in c.children:
+                walk(ch)
+            filt = c.arg("filter")
+            if isinstance(filt, Call):
+                walk(filt)
+            agg = c.arg("aggregate")
+            if isinstance(agg, Call):
+                walk(agg)
+
+        walk(call)
+        return tiered, cold
 
     def _mesh_ok(self, call: Call, n_shards: int) -> bool:
         """Can this call run as explicit mesh programs right now — a mesh
@@ -671,13 +842,19 @@ class Executor:
 
     def _bsi_stacked(self, idx: Index, field: Field, shards: list[int]):
         """uint32[D, S, W] bit-slice block for an int field (device,
-        row-major like every stack). BSI depth is ≤ 66 rows, so the
-        budget can only trip on huge shard lists — surface it clearly if
-        it does."""
+        row-major like every stack). Over-budget BSI stacks assemble
+        from tiered compressed slice rows in tiered residency mode
+        (docs/device-residency.md); the legacy slots mode surfaces the
+        budget error clearly as before."""
         try:
             m, _rows = self.compiler.stacks.matrix(idx, field, VIEW_BSI, shards)
         except StackOverBudget as e:
-            raise ExecutionError(str(e)) from e
+            if self.compiler.stacks.residency_mode() == "slots":
+                raise ExecutionError(str(e)) from e
+            try:
+                return self.compiler.tiered_bsi_block(idx, field, shards)
+            except StackOverBudget as e2:
+                raise ExecutionError(str(e2)) from e2
         need = BSI_OFFSET + field.bit_depth
         if m.shape[0] < need:
             m = jnp.pad(m, ((0, need - m.shape[0]), (0, 0), (0, 0)))

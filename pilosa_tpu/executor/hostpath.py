@@ -60,6 +60,34 @@ class HostPlanError(ValueError):
     pass
 
 
+def decode_container(
+    kind: str, payload: np.ndarray, n_shards: int, n_words: int
+) -> np.ndarray:
+    """Host decode of a tiered-residency container payload → packed
+    uint32[S, W] plane — the numpy inverse of residency.pack_container
+    and the HOST equivalence branch for every container kind the device
+    chooser can emit (ops/containers.py holds the device twins; the
+    analyzer's parity rule pins the two surfaces together).  Used by the
+    equivalence suite and the residency bench to prove bit-identical
+    results across containers."""
+    if kind == "dense":
+        return np.asarray(payload, dtype=np.uint32).reshape(n_shards, n_words)
+    bits = np.zeros(n_shards * n_words * 32, dtype=np.uint8)
+    if kind == "sparse":
+        ids = np.asarray(payload)
+        bits[ids[ids >= 0]] = 1
+    elif kind == "run":
+        for lo, hi in np.asarray(payload).reshape(-1, 2):
+            bits[lo:hi] = 1
+    else:
+        raise HostPlanError(f"unknown container kind {kind!r}")
+    return (
+        np.packbits(bits, bitorder="little")
+        .view(np.uint32)
+        .reshape(n_shards, n_words)
+    )
+
+
 def _popcount_sum(words: np.ndarray) -> int:
     # count through a uint64 view when possible: same bytes, half the
     # elements — measured ~2x faster than the uint32 chain, and the

@@ -321,7 +321,13 @@ class QueryRouter:
             return float("inf")  # host never slower per word: always host
         return max(0.0, overhead) / per_word
 
-    def decide(self, key: tuple, work_words: int, mesh_ok: bool = False) -> str:
+    def decide(
+        self,
+        key: tuple,
+        work_words: int,
+        mesh_ok: bool = False,
+        device_extra_words: int = 0,
+    ) -> str:
         if self.mode != "auto":
             return self.mode
         mesh_ok = mesh_ok and self.mesh_devices > 1
@@ -330,10 +336,23 @@ class QueryRouter:
         # even when calibration hasn't drifted. mesh_ok joins the key —
         # the same plan may be mesh-eligible on one shard subset and not
         # another (divisibility), and the memo must not cross them.
-        key = key + (int(work_words).bit_length(), mesh_ok)
+        # device_extra_words (tiered residency: cold-row upload traffic
+        # only the device path pays) joins bucketed too — the same plan
+        # re-evaluates as its working set warms.
+        key = key + (
+            int(work_words).bit_length(),
+            mesh_ok,
+            int(device_extra_words).bit_length(),
+        )
         memo = self._memo.get(key)
         if memo is not None and memo[0] == self._gen:
             return memo[1]
+        # cold tiered rows are packed at HOST scan speed and uploaded
+        # before the device program can run — charge the device (and
+        # mesh) route that host-side time on top of its own model
+        extra_s = (
+            device_extra_words / self._host_wps() if device_extra_words else 0.0
+        )
         if self.crossover_override > 0:
             route = (
                 "host" if work_words <= self.crossover_override else "device"
@@ -345,10 +364,10 @@ class QueryRouter:
         else:
             costs = [
                 (self.host_cost(work_words), "host"),
-                (self.device_cost(work_words), "device"),
+                (self.device_cost(work_words) + extra_s, "device"),
             ]
             if mesh_ok:
-                costs.append((self.mesh_cost(work_words), "mesh"))
+                costs.append((self.mesh_cost(work_words) + extra_s, "mesh"))
             # stable min: ties keep the earlier (host-first) entry, so
             # the pre-mesh host/device behavior is unchanged bit for bit
             route = min(costs, key=lambda cr: cr[0])[1]

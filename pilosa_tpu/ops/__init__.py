@@ -33,7 +33,7 @@ if (
     )
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
-from pilosa_tpu.ops import bsi, similarity, topn
+from pilosa_tpu.ops import bsi, containers, similarity, topn
 from pilosa_tpu.ops.bitwise import (
     column_mask,
     count_and,
@@ -54,6 +54,7 @@ from pilosa_tpu.ops.bitwise import (
 
 __all__ = [
     "bsi",
+    "containers",
     "similarity",
     "topn",
     "column_mask",

@@ -222,6 +222,26 @@ class MeshContext:
         """uint32[S, W] → sharded device array."""
         return self._place(arr, 0)
 
+    def place_block(self, arr):
+        """Compressed container payload stores (tiered residency:
+        sparse [H, K] id lists, run [H, K, 2] interval lists) → mesh-
+        placed REPLICATED arrays.  Payload ids live in the stacked
+        plane's global position space, so there is no [S, W] plane axis
+        to shard; replication keeps the single-program SPMD path working
+        — the decoded planes the query programs build from these blocks
+        merge with sharded dense stacks under GSPMD as usual."""
+        if self.multihost:
+            # replication requires identical data on every process, but
+            # container payloads are packed from process-local fragments
+            # — the tiered layer disables itself on multi-host meshes
+            # (StackCache.residency_mode), so reaching here is a bug
+            raise ValueError(
+                "compressed container stores cannot be placed on a "
+                "multi-host mesh (process-local payloads are not "
+                "replicable); over-budget fields use the slot path there"
+            )
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
 
 class MeshQueryEngine:
     """Compiles and caches sharded query programs over a fixed mesh.

@@ -506,6 +506,12 @@ class Handler(BaseHTTPRequestHandler):
         # device-cache effectiveness counters (tests assert the write
         # path stays incremental; operators read them here)
         out["stackCache"] = self.api.executor.compiler.stacks.stats_snapshot()
+        # tiered compressed residency: container tiers, hot/cold row
+        # promotion + demotion, per-container resident bytes
+        # (docs/device-residency.md)
+        out["deviceResidency"] = (
+            self.api.executor.compiler.stacks.residency_snapshot()
+        )
         # live cost-router calibration: mode, crossover, and the EWMAs
         # behind every host/device decision (docs/query-routing.md)
         out["queryRouting"] = self.api.executor.router.snapshot()

@@ -65,6 +65,17 @@ class Server:
         # suite needs the faults to land exactly where real disk faults
         # would. Uninstalled in close().
         self.fs_fault_injector = FSFaultInjector.from_config(self.config)
+        # first-class device stack budget (docs/device-residency.md):
+        # the config knob wins over the legacy PILOSA_TPU_STACK_BUDGET
+        # env resolution; 0 leaves auto-resolution in place
+        from pilosa_tpu.executor import compile as query_compile
+
+        # unconditional: a 0 (auto) config must CLEAR any override a
+        # previous Server in this process installed, or its budget
+        # would silently leak into this one's auto-resolution
+        query_compile.set_stack_budget(
+            self.config.device_stack_budget_bytes or None
+        )
         # per-call host/device cost router (docs/query-routing.md),
         # seeded from config; the SAME router instance survives the
         # late mesh attach so its calibration carries over

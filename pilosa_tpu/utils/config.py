@@ -92,6 +92,12 @@ class Config:
     # one engine (the server also pins "host" when the device probe
     # fails — the degraded engine must not pay device dispatch).
     route_mode: str = "auto"  # auto | host | device
+    # device stack budget in bytes — the aggregate cap on resident query
+    # stacks (dense stacks + hot-row slots + tiered container stores;
+    # docs/device-residency.md). 0 = auto: the legacy
+    # PILOSA_TPU_STACK_BUDGET env override if set, else 70% of the
+    # device's reported HBM limit, else 2 GiB.
+    device_stack_budget_bytes: int = 0
     # >0 pins the crossover (words of packed-bitmap work below which a
     # read runs on the host); 0 derives it from the calibrated model
     route_crossover_words: float = 0.0
@@ -277,6 +283,7 @@ def config_template() -> str:
         "num-processes = 0\n"
         "process-id = -1\n"
         'route-mode = "auto"\n'
+        "device-stack-budget-bytes = 0\n"
         "route-crossover-words = 0.0\n"
         "route-dispatch-ms = 1.0\n"
         "route-readback-ms = 2.0\n"

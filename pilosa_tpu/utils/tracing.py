@@ -288,6 +288,7 @@ class QueryProfile:
         "fanout",
         "wave",
         "mesh",
+        "residency",
         "_last_rpc_bytes",
     )
 
@@ -305,6 +306,11 @@ class QueryProfile:
         # surface for multi-chip execution; per-call entries carry the
         # route tag already)
         self.mesh: dict | None = None
+        # set by the executor when the query touched tiered compressed
+        # residency (docs/device-residency.md): container tiers,
+        # promotion/demotion counters — the ?profile=true surface for
+        # the hot/cold row tier
+        self.residency: dict | None = None
         self._last_rpc_bytes = 0
 
     def add_call(
@@ -374,6 +380,8 @@ class QueryProfile:
             out["wave"] = self.wave
         if self.mesh is not None:
             out["mesh"] = self.mesh
+        if self.residency is not None:
+            out["residency"] = self.residency
         if self.trace_id:
             out["traceID"] = self.trace_id
         return out

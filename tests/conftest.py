@@ -67,6 +67,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "residency: tiered compressed device residency suite — container "
+        "equivalence across dense/sparse/run, hot/cold promotion and "
+        "demotion, byte-ledger concurrency (tests/test_residency.py; runs "
+        "in tier-1 — the marker exists so `pytest -m residency` scopes to "
+        "it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -231,6 +231,44 @@ def test_parity_mesh_fallback_annotation_suffices(tree_copy):
     assert rc == 0, out
 
 
+def test_parity_container_decode_branch_removed_fails(tree_copy):
+    # drop the host equivalence branch for the "run" container kind:
+    # tiered rows the chooser packs as runs would have no host-side
+    # decode — the exact drift the container-parity rule exists for
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "hostpath.py",
+        'elif kind == "run":',
+        'elif kind == "run-disabled":',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "'run'" in out and "decode_container" in out
+
+
+def test_parity_container_kind_added_without_decode_fails(tree_copy):
+    # grow the chooser taxonomy without teaching either engine: both
+    # the host and the device decode surfaces must flag the new kind
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "residency.py",
+        'CONTAINER_KINDS = {"dense", "sparse", "run"}',
+        'CONTAINER_KINDS = {"dense", "sparse", "run", "bitpacked"}',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "'bitpacked'" in out
+
+
+def test_parity_device_tiered_leaf_branch_removed_fails(tree_copy):
+    mutate(
+        tree_copy / "pilosa_tpu" / "executor" / "compile.py",
+        'elif kind == "sparse":\n\n            def run',
+        'elif kind == "sparse-disabled":\n\n            def run',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[parity]" in out and "_tiered_leaf" in out
+
+
 def test_observability_missing_handler_fails(tree_copy):
     mutate(
         tree_copy / "pilosa_tpu" / "server" / "http.py",

@@ -15,10 +15,14 @@ from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
 @pytest.fixture
 def tight_budget(monkeypatch):
     # enough for ~64 resident rows per shard-pair — far below the field
-    # sizes used here, so the hot path must engage
+    # sizes used here, so the hot path must engage. This suite pins the
+    # LEGACY dense slot path ("slots"); the tiered compressed layer that
+    # now serves over-budget fields by default has its own suite
+    # (tests/test_residency.py).
     monkeypatch.setattr(
         StackCache, "STACK_BYTES_BUDGET", 64 * 2 * WORDS_PER_SHARD * 4
     )
+    monkeypatch.setattr(StackCache, "RESIDENCY_MODE", "slots")
 
 
 def _high_card_holder(n_rows=100_000, n_shards=2, seed=0):

@@ -1,0 +1,532 @@
+"""Tiered compressed device residency (ISSUE 9, docs/device-residency.md).
+
+Over-budget fields serve resident rows as per-row COMPRESSED containers
+(dense words / sparse ids / run intervals) with a hot/cold LRU tier:
+every PQL read call type must return bit-identical results across
+container kinds, across hot-resident vs demoted-cold rows, and across
+the host / device / mesh routes; the working set must actually cycle
+(promote on repeated touches, demote on LRU pressure); and the
+StackCache byte ledger must hold under concurrent builds.
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from pilosa_tpu import ops
+from pilosa_tpu.core import FieldOptions, Holder
+from pilosa_tpu.executor import Executor
+from pilosa_tpu.executor import residency
+from pilosa_tpu.executor.compile import (
+    StackCache,
+    reset_stack_budget_cache,
+    set_stack_budget,
+)
+from pilosa_tpu.executor.hostpath import decode_container
+from pilosa_tpu.executor.router import QueryRouter
+from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
+from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils.stats import StatsClient
+
+pytestmark = pytest.mark.residency
+
+N_SHARDS = 2
+PLANE_WORDS = N_SHARDS * WORDS_PER_SHARD
+
+
+@pytest.fixture
+def tight_budget(monkeypatch):
+    # well below the ~80-row dense stacks built here, so every standard
+    # row serves through the tiered compressed layer (the default mode)
+    monkeypatch.setattr(
+        StackCache, "STACK_BYTES_BUDGET", 48 * N_SHARDS * WORDS_PER_SHARD * 4
+    )
+
+
+def _mixed_holder(seed=0, n_rows=5000):
+    """Rows engineered to hit every container kind: one bit per row
+    (sparse), a contiguous block row (run), a random half-full row
+    (dense), plus an int (BSI) field and a popular band for TopN."""
+    rng = np.random.default_rng(seed)
+    h = Holder(None)
+    idx = h.create_index("res")
+    f = idx.create_field("f")
+    rows = np.arange(n_rows, dtype=np.uint64)
+    cols = rng.integers(0, N_SHARDS * SHARD_WIDTH, size=n_rows).astype(np.uint64)
+    f.import_bulk(rows, cols)
+    # run row 10: one contiguous range crossing a shard boundary
+    f.import_bulk(
+        np.full(3000, 10, np.uint64),
+        (np.arange(3000) + SHARD_WIDTH - 1500).astype(np.uint64),
+    )
+    # dense row 11: random half of all columns
+    dense_cols = rng.choice(
+        N_SHARDS * SHARD_WIDTH, size=SHARD_WIDTH, replace=False
+    ).astype(np.uint64)
+    f.import_bulk(np.full(dense_cols.size, 11, np.uint64), dense_cols)
+    idx.mark_columns_exist(cols)
+    idx.mark_columns_exist(dense_cols)
+    v = idx.create_field("v", FieldOptions(field_type="int"))
+    vcols = rng.choice(N_SHARDS * SHARD_WIDTH, size=600, replace=False).astype(
+        np.uint64
+    )
+    vvals = rng.integers(-500, 50000, size=600)
+    for c, val in zip(vcols.tolist(), vvals.tolist()):
+        v.set_value(int(c), int(val))
+    idx.mark_columns_exist(vcols)
+    return h
+
+
+READ_QUERIES = [
+    "Row(f=7)",
+    "Row(f=10)",
+    "Row(f=11)",
+    "Count(Row(f=7))",
+    "Count(Row(f=10))",
+    "Count(Row(f=11))",
+    "Count(Union(Row(f=7), Row(f=10), Row(f=11)))",
+    "Count(Intersect(Row(f=10), Row(f=11)))",
+    "Count(Difference(Row(f=11), Row(f=10)))",
+    "Count(Xor(Row(f=10), Row(f=11)))",
+    "Count(Not(Row(f=11)))",
+    "Count(All())",
+    "Count(Shift(Row(f=10), n=5))",
+    "Count(Row(v > 500))",
+    "Count(Row(v < 0))",
+    "Count(Row(-5 < v < 40000))",
+    "Count(Row(v != null))",
+    "Sum(field=v)",
+    "Sum(Row(f=11), field=v)",
+    "Min(field=v)",
+    "Max(field=v)",
+    "TopN(f, n=5)",
+    "TopN(f, n=3, ids=[7, 10, 11])",
+    "GroupBy(Rows(f, limit=12))",
+    "IncludesColumn(Row(f=10), column=%d)" % (SHARD_WIDTH - 100),
+    "Rows(f, limit=5)",
+]
+
+
+def _norm(x):
+    if hasattr(x, "columns"):
+        return x.columns().tolist()
+    try:
+        return json.dumps(x, sort_keys=True)
+    except TypeError:
+        return repr(x)
+
+
+# ----------------------------------------------------- container primitives
+def test_chooser_picks_each_kind():
+    plane = np.zeros((N_SHARDS, WORDS_PER_SHARD), np.uint32)
+    assert residency.choose_container(*analyze(plane), PLANE_WORDS) == "run"
+    plane[0, 5] = 0b1010001  # scattered bits
+    assert residency.choose_container(*analyze(plane), PLANE_WORDS) == "sparse"
+    run_plane = np.zeros_like(plane)
+    run_plane[0, :100] = 0xFFFFFFFF
+    assert (
+        residency.choose_container(*analyze(run_plane), PLANE_WORDS) == "run"
+    )
+    rng = np.random.default_rng(0)
+    dense_plane = rng.integers(
+        0, 2**32, size=plane.shape, dtype=np.uint32
+    )
+    assert (
+        residency.choose_container(*analyze(dense_plane), PLANE_WORDS)
+        == "dense"
+    )
+
+
+def analyze(plane):
+    return residency.analyze_plane(plane)
+
+
+@pytest.mark.parametrize("kind", sorted(residency.CONTAINER_KINDS))
+def test_pack_decode_roundtrip_host_and_device(kind):
+    rng = np.random.default_rng(3)
+    plane = np.zeros((N_SHARDS, WORDS_PER_SHARD), np.uint32)
+    if kind == "dense":
+        plane[:] = rng.integers(0, 2**32, size=plane.shape, dtype=np.uint32)
+    elif kind == "sparse":
+        flat = plane.reshape(-1)
+        flat[rng.choice(flat.size, 200, replace=False)] = np.uint32(1) << rng.integers(
+            0, 32, 200
+        ).astype(np.uint32)
+    else:
+        plane[0, 10:200] = 0xFFFFFFFF
+        plane[1, 0:7] = 0xFFFFFFFF
+        plane[0, 9] = 0xFFFF0000  # partial-word run edge
+    payload = residency.pack_container(kind, plane)
+    # host inverse (the parity-rule equivalence branch)
+    host = decode_container(kind, payload, N_SHARDS, WORDS_PER_SHARD)
+    np.testing.assert_array_equal(host, plane)
+    # device twin decodes the same plane
+    if kind == "sparse":
+        dev = ops.containers.sparse_plane(
+            np.asarray(payload, np.int32), N_SHARDS, WORDS_PER_SHARD
+        )
+        assert int(ops.containers.sparse_count(np.asarray(payload, np.int32))) == int(
+            np.bitwise_count(plane).sum()
+        )
+    elif kind == "run":
+        dev = ops.containers.run_plane(
+            np.asarray(payload, np.int32), N_SHARDS, WORDS_PER_SHARD
+        )
+        assert int(ops.containers.run_count(np.asarray(payload, np.int32))) == int(
+            np.bitwise_count(plane).sum()
+        )
+    else:
+        dev = payload
+    np.testing.assert_array_equal(np.asarray(dev), plane)
+
+
+# ------------------------------------------------------- route equivalence
+def test_full_read_surface_equivalence(tight_budget):
+    """Every read call type: bit-identical across the tiered device
+    path (cold → promoted → resident, three touches), the host path,
+    and a budget-free dense device executor."""
+    h = _mixed_holder()
+    ed = Executor(h, route_mode="device")
+    eh = Executor(h, route_mode="host")
+    # budget-free reference: dense stacks, no containers
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(StackCache, "STACK_BYTES_BUDGET", 1 << 40)
+        free = Executor(h, route_mode="device")
+        for q in READ_QUERIES:
+            dense_ref = _norm(free.execute("res", q)[0])
+            host_ref = _norm(eh.execute("res", q)[0])
+            assert host_ref == dense_ref, q
+    for q in READ_QUERIES:
+        host_ref = _norm(eh.execute("res", q)[0])
+        for touch in range(3):  # cold, promote, resident
+            got = _norm(ed.execute("res", q)[0])
+            assert got == host_ref, (q, touch)
+    snap = ed.compiler.stacks.residency_snapshot()
+    assert snap["rowsPromoted"] > 0
+    assert snap["coldUploads"] > 0
+    # all three container kinds actually engaged (re-touch the three
+    # marker rows first — budget pressure during the sweep above may
+    # have evicted whole tiered entries, which is working as intended)
+    for _ in range(2):
+        ed.execute("res", "Count(Union(Row(f=7), Row(f=10), Row(f=11)))")
+    kinds_used = set()
+    for t in ed.compiler.stacks.residency_snapshot()["tiers"]:
+        kinds_used |= {k for k, n in t["rows"].items() if n > 0}
+    assert kinds_used >= {"dense", "sparse", "run"}
+
+
+def test_mesh_route_equivalence(tight_budget):
+    """route-mode=mesh on a mesh-attached executor: tiered fields fall
+    back to the single-program device path with mesh-placed container
+    stores — results stay bit-identical to the host engine."""
+    if len(jax.devices()) < 2:
+        pytest.skip("needs the multi-device virtual platform")
+    from pilosa_tpu.parallel.mesh import MeshContext, make_mesh
+
+    h = _mixed_holder()
+    em = Executor(
+        h,
+        route_mode="mesh",
+        mesh_ctx=MeshContext(make_mesh(jax.devices(), words_axis=1)),
+    )
+    eh = Executor(h, route_mode="host")
+    for q in READ_QUERIES:
+        host_ref = _norm(eh.execute("res", q)[0])
+        for _ in range(2):
+            assert _norm(em.execute("res", q)[0]) == host_ref, q
+
+
+def test_count_direct_skips_plane(tight_budget):
+    """Count(Row) over a sparse/run container compiles the direct
+    payload count — no [S, W] plane even transiently."""
+    h = _mixed_holder()
+    e = Executor(h, route_mode="device")
+    for _ in range(3):  # promote rows 7 (sparse) and 10 (run)
+        e.execute("res", "Count(Row(f=7))")
+        e.execute("res", "Count(Row(f=10))")
+    keys = [k for k in e.compiler._programs if "count-direct" in k]
+    assert len(keys) >= 2, keys
+    eh = Executor(h, route_mode="host")
+    assert (
+        e.execute("res", "Count(Row(f=7))")[0]
+        == eh.execute("res", "Count(Row(f=7))")[0]
+    )
+
+
+# --------------------------------------------------- tier cycling + routing
+def test_working_set_promotes_demotes_and_rewarms(tight_budget):
+    """The shifting-working-set contract: repeated touches promote a
+    row set into compressed residency; a shifted set LRU-demotes it;
+    re-touching re-warms it — visible via queries_routed and the
+    residency counters."""
+    h = _mixed_holder(n_rows=6000)
+    stats = StatsClient()
+    e = Executor(h, stats=stats, route_mode="device")
+    stacks = e.compiler.stacks
+    idx = h.index("res")
+    f = idx.field("f")
+    shards = [0, 1]
+
+    # rows 20..39: one scattered bit each — all classify sparse, so the
+    # whole set lives (and cycles) in ONE container store
+    set_a = list(range(20, 40))
+    for _ in range(2):
+        for r in set_a:
+            e.execute("res", f"Count(Row(f={r}))")
+    assert all(
+        stacks.tiered_resident(idx, f, "standard", shards, r) for r in set_a
+    )
+    promoted_after_a = stacks.rows_promoted
+    assert promoted_after_a >= len(set_a)
+
+    # shift the working set: enough rows to exhaust the sparse store
+    cap = stacks._tiered[
+        ("tier", "res", "f", "standard", tuple(shards))
+    ].stores["sparse"]["h"]
+    set_b = list(range(100, 100 + cap))
+    for _ in range(2):
+        for r in set_b:
+            e.execute("res", f"Count(Row(f={r}))")
+    assert stacks.rows_demoted > 0
+    assert not any(
+        stacks.tiered_resident(idx, f, "standard", shards, r) for r in set_a
+    )
+    # the demoted-cold rows still answer exactly (host-packed upload)
+    eh = Executor(h, route_mode="host")
+    for r in set_a[:3]:
+        q = f"Count(Row(f={r}))"
+        assert e.execute("res", q)[0] == eh.execute("res", q)[0]
+    # ...and re-warm: their touch history promotes them straight back
+    for r in set_a[:3]:
+        e.execute("res", f"Count(Row(f={r}))")
+    assert all(
+        stacks.tiered_resident(idx, f, "standard", shards, r)
+        for r in set_a[:3]
+    )
+    assert stacks.rows_promoted > promoted_after_a
+    # promoted rows serve from the device path (queries_routed counter)
+    assert stats._counters[("queries_routed", (("path", "device"),))] > 0
+
+
+def test_router_charges_cold_uploads():
+    """decide() must charge the device path for cold-row upload work:
+    a big cold set routes host; the same work with a warm (resident)
+    set routes device."""
+    r = QueryRouter(mode="auto", host_wps=1e9, clock=lambda: 0.0)
+    work = 1 << 22  # far above the dispatch-overhead crossover
+    assert r.decide(("k",), work) == "device"
+    # cold uploads comparable to the work itself tip the decision host
+    assert r.decide(("k",), work, device_extra_words=1 << 28) == "host"
+    # warm again (different memo bucket) — back to device
+    assert r.decide(("k",), work, device_extra_words=0) == "device"
+
+
+def test_residency_info_sees_cold_then_resident(tight_budget):
+    h = _mixed_holder()
+    e = Executor(h, route_mode="device")
+    idx = h.index("res")
+    call = __import__("pilosa_tpu.pql", fromlist=["parse"]).parse(
+        "Count(Row(f=7))"
+    )[0]
+    tiered, cold = e._residency_info(idx, call.children[0], None)
+    assert tiered and cold > 0
+    for _ in range(2):
+        e.execute("res", "Count(Row(f=7))")
+    tiered, cold = e._residency_info(idx, call.children[0], None)
+    assert tiered and cold == 0
+
+
+# -------------------------------------------------------- byte ledger + LRU
+def test_reserved_claims_under_concurrent_same_key_builds(monkeypatch):
+    """Two concurrent builders of the SAME key must each hold their own
+    in-flight byte claim (the per-build-token _reserved ledger), and
+    the ledger must settle exactly once both install."""
+    from pilosa_tpu.executor import compile as C
+
+    h = Holder(None)
+    idx = h.create_index("led")
+    f = idx.create_field("a")
+    f.import_bulk(
+        np.array([0, 1], dtype=np.uint64), np.array([1, 2], dtype=np.uint64)
+    )
+    monkeypatch.setattr(StackCache, "STACK_BYTES_BUDGET", 1 << 30)
+    stacks = StackCache()
+    one_stack = 8 * WORDS_PER_SHARD * 4  # [R_pad=8, S=1, W] uint32
+
+    started = threading.Barrier(2, timeout=10)
+    claims: list[int] = []
+    real = C.stack_view_matrices
+
+    def slow_stack(view, shards):
+        started.wait()  # both builders inside the build window
+        claims.append(sum(stacks._reserved.values()))
+        return real(view, shards)
+
+    monkeypatch.setattr(C, "stack_view_matrices", slow_stack)
+    errs: list[Exception] = []
+
+    def build():
+        try:
+            stacks.matrix(idx, f, "standard", [0])
+        except Exception as exc:  # pragma: no cover - surfaced below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=build) for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    # both concurrent builds held a claim simultaneously
+    assert max(claims) == 2 * one_stack
+    # ...and the ledger settled: claims released, one entry accounted
+    assert stacks._reserved == {}
+    assert stacks.resident_bytes == one_stack
+
+
+def test_build_failure_releases_reservation(monkeypatch):
+    from pilosa_tpu.executor import compile as C
+
+    h = Holder(None)
+    idx = h.create_index("led2")
+    f = idx.create_field("a")
+    f.import_bulk(
+        np.array([0], dtype=np.uint64), np.array([1], dtype=np.uint64)
+    )
+    stacks = StackCache()
+
+    def boom(view, shards):
+        raise RuntimeError("synthetic build failure")
+
+    monkeypatch.setattr(C, "stack_view_matrices", boom)
+    with pytest.raises(RuntimeError):
+        stacks.matrix(idx, f, "standard", [0])
+    assert stacks._reserved == {}
+    assert stacks.resident_bytes == 0
+
+
+def test_evict_for_dense_then_hot_then_tiered_order(monkeypatch):
+    """Victim order: dense stacks first (cheapest to rebuild), then hot
+    slot stacks, then tiered container entries."""
+    monkeypatch.setattr(StackCache, "STACK_BYTES_BUDGET", 1000)
+    stacks = StackCache()
+    for key, size in (("d1", 300), ("d2", 300)):
+        stacks._cache[key] = ("v", None, 1, None)
+        stacks._account(key, size)
+    stacks._hot["h1"] = {}
+    stacks._account("h1", 200)
+
+    class _E:
+        stores = {}
+
+    stacks._tiered["t1"] = _E()
+    stacks._account("t1", 200)
+    assert stacks.resident_bytes == 1000
+    stacks._evict_for(300)  # evicts LRU dense only
+    assert "d1" not in stacks._cache and "d2" in stacks._cache
+    assert "h1" in stacks._hot and "t1" in stacks._tiered
+    stacks._evict_for(700)  # d2, then h1 — tiered survives
+    assert not stacks._cache and not stacks._hot
+    assert "t1" in stacks._tiered
+    stacks._evict_for(900)  # finally the tiered entry
+    assert not stacks._tiered
+    assert stacks.evictions == {"dense": 2, "hot": 1, "tiered": 1}
+
+
+# ----------------------------------------------------- config + observability
+def test_budget_knob_and_cache_reset(monkeypatch):
+    from pilosa_tpu.executor import compile as C
+    from pilosa_tpu.utils.config import Config, config_template, load_config
+
+    # first-class config field, env-coercible, templated
+    assert Config().device_stack_budget_bytes == 0
+    cfg = load_config(env={"PILOSA_TPU_DEVICE_STACK_BUDGET_BYTES": "4096"})
+    assert cfg.device_stack_budget_bytes == 4096
+    assert "device-stack-budget-bytes = 0" in config_template()
+    # explicit override wins over the legacy env var...
+    monkeypatch.setenv("PILOSA_TPU_STACK_BUDGET", "12345")
+    set_stack_budget(9999)
+    try:
+        assert C._stack_budget() == 9999
+        # ...and clearing it makes the cache resettable, not append-only
+        set_stack_budget(None)
+        assert C._stack_budget() == 12345
+        monkeypatch.setenv("PILOSA_TPU_STACK_BUDGET", "54321")
+        reset_stack_budget_cache()
+        assert C._stack_budget() == 54321
+    finally:
+        set_stack_budget(None)
+        monkeypatch.delenv("PILOSA_TPU_STACK_BUDGET")
+        reset_stack_budget_cache()
+
+
+def test_observability_counters_and_profile(tight_budget):
+    stats = StatsClient()
+    h = _mixed_holder()
+    e = Executor(h, stats=stats, route_mode="device")
+    prof = tracing.QueryProfile()
+    with tracing.use_profile(prof):
+        for _ in range(2):
+            e.execute("res", "Count(Union(Row(f=7), Row(f=10), Row(f=11)))")
+    # promotion counters + per-container byte gauges reached the registry
+    assert stats._counters[("rows_promoted", ())] > 0
+    gauges = {k[1][0][1] for k in stats._gauges if k[0] == "residency_bytes"}
+    assert gauges >= {"dense", "sparse", "run"}
+    # ?profile=true carries the residency block
+    out = prof.to_json()
+    assert "residency" in out
+    assert out["residency"]["rowsPromoted"] > 0
+    # /debug/vars section shape
+    snap = e.compiler.stacks.residency_snapshot()
+    for field in (
+        "mode",
+        "entries",
+        "rowsPromoted",
+        "rowsDemoted",
+        "coldUploads",
+        "evictions",
+        "bytesByContainer",
+        "tiers",
+    ):
+        assert field in snap
+    # eviction counter flows through the stats client when pressure hits
+    e.compiler.stacks._evict_for(1 << 60)
+    assert any(k[0] == "stack_evictions_total" for k in stats._counters)
+
+
+def test_cold_program_structure_not_aliased(tight_budget):
+    """Cold leaves are per-row inputs: a duplicate-row union (one
+    deduped input) and a distinct-row union (two inputs) must compile
+    DIFFERENT programs — a row-blind structure key would reuse the
+    first and silently drop the second query's extra leaf."""
+    h = _mixed_holder()
+    e = Executor(h, route_mode="device")
+    eh = Executor(h, route_mode="host")
+    # both executions are FIRST touches ⇒ cold leaves
+    dup = "Count(Union(Row(f=50), Row(f=50)))"
+    distinct = "Count(Union(Row(f=51), Row(f=52)))"
+    assert e.execute("res", dup)[0] == eh.execute("res", dup)[0]
+    assert e.execute("res", distinct)[0] == eh.execute("res", distinct)[0]
+    # and in the other compile order too (fresh executor, fresh rows)
+    e2 = Executor(h, route_mode="device")
+    d2 = "Count(Union(Row(f=53), Row(f=54)))"
+    dup2 = "Count(Union(Row(f=55), Row(f=55)))"
+    assert e2.execute("res", d2)[0] == eh.execute("res", d2)[0]
+    assert e2.execute("res", dup2)[0] == eh.execute("res", dup2)[0]
+
+
+def test_write_invalidates_tiered_rows(tight_budget):
+    h = _mixed_holder()
+    e = Executor(h, route_mode="device")
+    eh = Executor(h, route_mode="host")
+    for _ in range(2):
+        e.execute("res", "Count(Row(f=10))")
+    base = e.execute("res", "Count(Row(f=10))")[0]
+    e.execute("res", "Set(3, f=10)")
+    after = e.execute("res", "Count(Row(f=10))")[0]
+    assert after == base + 1
+    assert eh.execute("res", "Count(Row(f=10))")[0] == after

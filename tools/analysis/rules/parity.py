@@ -43,6 +43,8 @@ EXECUTOR = "executor/executor.py"
 HOSTPATH = "executor/hostpath.py"
 SCHEDULER = "executor/scheduler.py"
 MESH = "parallel/mesh.py"
+RESIDENCY = "executor/residency.py"
+COMPILE = "executor/compile.py"
 _EXEMPT = {"Options", "Rows"}
 # program-builder methods the mesh engine must define for the read
 # surface MESH_PROGRAMS/MESH_AGGREGATES claim (executor mesh branches
@@ -337,4 +339,94 @@ def check_parity(project: Project) -> list[Violation]:
                         "route would fail at runtime on that call family",
                     )
                 )
+
+    # 6. container-kind parity (tiered compressed residency,
+    # docs/device-residency.md): every kind in the device chooser's
+    # CONTAINER_KINDS literal must have (a) a HostEngine equivalence
+    # branch — a ``kind == X`` comparison in hostpath's
+    # decode_container — and (b) a device decode branch in the planner's
+    # tiered leaf (compile.py).  A kind without both sides returns wrong
+    # or failing answers the day the chooser emits it.
+    res = project.find(RESIDENCY)
+    comp = project.find(COMPILE)
+    if res is not None and res.tree is not None:
+        kinds = _set_literal(res.tree, "CONTAINER_KINDS")
+        if not kinds:
+            out.append(
+                Violation(
+                    "parity",
+                    res.rel,
+                    1,
+                    "executor/residency.py must declare the CONTAINER_KINDS "
+                    "set literal — the container taxonomy contract",
+                )
+            )
+        else:
+            decode = None
+            for n in ast.walk(hp.tree):
+                if (
+                    isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and n.name == "decode_container"
+                ):
+                    decode = n
+                    break
+            if decode is None:
+                out.append(
+                    Violation(
+                        "parity",
+                        hp.rel,
+                        1,
+                        "hostpath.py must define decode_container() — the "
+                        "host equivalence surface for tiered container "
+                        "payloads",
+                    )
+                )
+            else:
+                handled = _compared_names(decode, "kind")
+                for k in sorted(kinds - handled):
+                    out.append(
+                        Violation(
+                            "parity",
+                            hp.rel,
+                            decode.lineno,
+                            f"container kind {k!r} (residency "
+                            "CONTAINER_KINDS) has no decode_container "
+                            "branch — no host equivalence for rows the "
+                            "chooser packs that way",
+                        )
+                    )
+            if comp is not None and comp.tree is not None:
+                leaf = None
+                for n in ast.walk(comp.tree):
+                    if (
+                        isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and n.name == "_tiered_leaf"
+                    ):
+                        leaf = n
+                        break
+                if leaf is None:
+                    out.append(
+                        Violation(
+                            "parity",
+                            comp.rel,
+                            1,
+                            "compile.py must define _Planner._tiered_leaf() "
+                            "— the device decode surface for container "
+                            "payloads",
+                        )
+                    )
+                else:
+                    handled = _compared_names(leaf, "kind")
+                    for k in sorted(kinds - handled):
+                        out.append(
+                            Violation(
+                                "parity",
+                                comp.rel,
+                                leaf.lineno,
+                                f"container kind {k!r} (residency "
+                                "CONTAINER_KINDS) has no _tiered_leaf device "
+                                "decode branch — tiered-resident rows of "
+                                "that kind cannot be served",
+                            )
+                        )
     return out
