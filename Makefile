@@ -1,4 +1,8 @@
-.PHONY: check fix test analyze bench-ingest bench-residency
+# bash for pipefail: the bench-observability gate must not be masked
+# by the artifact tee
+SHELL := /bin/bash
+
+.PHONY: check fix test analyze bench-ingest bench-residency bench-observability
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -25,3 +29,9 @@ bench-ingest:
 # forced-host baseline + compression ratio; exits non-zero below 1.0x
 bench-residency:
 	PILOSA_BENCH_ALL_CHILD=residency python bench_all.py
+
+# flight-recorder + router-audit overhead row (docs/observability.md):
+# instrumented-on vs instrumented-off c1 p50/p99 on the config8 count
+# shape; exits non-zero if the always-on layer costs >3% p50
+bench-observability:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=observability python bench_all.py | tee BENCH_OBS_r10.json

@@ -190,6 +190,60 @@ def cmd_export(args) -> int:
     return 0
 
 
+def cmd_explain(args) -> int:
+    """EXPLAIN / EXPLAIN ANALYZE over HTTP (docs/observability.md):
+    POSTs the query with ``?explain=true`` (plan only — nothing
+    executes) or ``?explain=analyze`` (execute + measured actuals next
+    to each estimate) and renders the router cost table, residency
+    classification, mesh verdict, and wave batchability."""
+    _apply_skip_verify(args)
+    mode = "analyze" if args.analyze else "true"
+    url = f"{_base_uri(args.host)}/index/{args.index}/query?explain={mode}"
+    if args.shards:
+        url += f"&shards={args.shards}"
+    out = _http("POST", url, args.query.encode(), ctype="text/plain")
+    if args.json:
+        print(json.dumps(out, indent=2))
+        return 0
+    plan = out.get("explain", out)
+    print(f"query:      {plan.get('query')}")
+    print(f"route mode: {plan.get('routeMode')}"
+          f"  crossover words: {plan.get('crossoverWords'):.0f}")
+    wave = plan.get("waveScheduler", {})
+    print(f"wave:       batchable={wave.get('batchable')}"
+          f" ({wave.get('reason')})")
+    for i, c in enumerate(plan.get("calls", [])):
+        print(f"call {i}: {c.get('call')}  route={c.get('route')}"
+              + (f"  actual={c.get('actualRoute')}"
+                 f" {c.get('actualSeconds', 0) * 1e3:.3f}ms"
+                 if "actualSeconds" in c else ""))
+        if "estimatedWorkWords" in c:
+            print(f"  work estimate: {c['estimatedWorkWords']} words")
+        for path, cand in sorted(c.get("candidates", {}).items()):
+            mark = "*" if cand.get("chosen") else " "
+            line = (f"  {mark} {path:<7}"
+                    f" est {cand['estimatedSeconds'] * 1e3:9.3f}ms")
+            if "measuredSeconds" in cand:
+                line += (f"  measured {cand['measuredSeconds'] * 1e3:9.3f}ms"
+                         f"  error x{cand['errorRatio']:.2f}")
+            print(line)
+        res = c.get("residency")
+        if res and res.get("tiered"):
+            print(f"  residency: tiered, coldUploadWords="
+                  f"{res.get('coldUploadWords')}")
+        mesh = c.get("mesh")
+        if mesh is not None:
+            print(f"  mesh: supported={mesh.get('supported')}"
+                  f" ({mesh.get('reason')})")
+    if "actualTotalSeconds" in plan:
+        print(f"total: {plan['actualTotalSeconds'] * 1e3:.3f}ms"
+              + (f"  readback: {plan['actualReadbackSeconds'] * 1e3:.3f}ms"
+                 if "actualReadbackSeconds" in plan else ""))
+    if "results" in out:
+        print(f"results: {json.dumps(out['results'])[:400]}")
+    return 0
+
+
 def cmd_config(args) -> int:
     from pilosa_tpu.utils.config import config_template, dump_config, load_config
 
@@ -292,6 +346,21 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("-i", "--index", required=True)
     s.add_argument("-f", "--field", required=True)
     s.set_defaults(fn=cmd_export)
+
+    s = sub.add_parser(
+        "explain", help="EXPLAIN / EXPLAIN ANALYZE a PQL query"
+    )
+    s.add_argument("query", help="PQL query string")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port or https://host:port for TLS servers")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
+    s.add_argument("-i", "--index", required=True)
+    s.add_argument("--shards", default=None, help="comma-separated shard list")
+    s.add_argument("--analyze", action="store_true",
+                   help="execute too and attach measured actuals")
+    s.add_argument("--json", action="store_true", help="raw JSON output")
+    s.set_defaults(fn=cmd_explain)
 
     s = sub.add_parser("config", help="print effective config")
     s.add_argument("--config", default=None)

@@ -82,12 +82,18 @@ class Compactor:
         the workers so a Holder used without a Server still compacts."""
         if getattr(fragment, "_dropped", False):
             return False  # relinquished in a resize handoff; file is gone
+        # capture the REQUESTING thread's trace context: the compaction
+        # this write triggered runs on a background worker, but its
+        # compaction.run span must join the originating query's trace —
+        # a slow query whose write tripped a compaction is only
+        # self-explaining if the trace shows the compaction it caused
+        ctx = GLOBAL_TRACER.current_context()
         with self._lock:
             if self._closed:
                 return False
             if fragment.uid in self._queued or fragment.uid in self._inflight:
                 return False
-            self._queue.append((fragment, reason))
+            self._queue.append((fragment, reason, ctx))
             self._queued.add(fragment.uid)
             self._cond.notify()
             started = bool(self._threads)
@@ -132,12 +138,12 @@ class Compactor:
                     self._cond.wait()
                 if self._closed and not self._queue:
                     return
-                fragment, reason = self._queue.popleft()
+                fragment, reason, ctx = self._queue.popleft()
                 self._queued.discard(fragment.uid)
                 self._inflight.add(fragment.uid)
             ok = False
             try:
-                ok = self._compact_one(fragment, reason)
+                ok = self._compact_one(fragment, reason, ctx)
             finally:
                 # a write burst that outran the fold leaves the ops log
                 # over threshold with no future append to re-queue it —
@@ -154,21 +160,30 @@ class Compactor:
                 with self._lock:
                     self._inflight.discard(fragment.uid)
                     if requeue and fragment.uid not in self._queued:
-                        self._queue.append((fragment, "followup"))
+                        # follow-up of the same trigger: keep the
+                        # originating context so the whole fold chain
+                        # stays navigable from one trace
+                        self._queue.append((fragment, "followup", ctx))
                         self._queued.add(fragment.uid)
                         self._cond.notify()
                     self._cond.notify_all()
                 self._gauge()
 
-    def _compact_one(self, fragment, reason: str) -> bool:
+    def _compact_one(self, fragment, reason: str, ctx=None) -> bool:
         try:
-            with GLOBAL_TRACER.span(
-                "compaction.run",
-                path=str(fragment.path),
-                reason=reason,
-                op_n=fragment.op_n,
-            ):
-                committed = bool(fragment.compact())
+            # join the trace of the write that queued this compaction
+            # (ctx is (trace_id, span_id) captured at request time);
+            # detached() also isolates the worker from any leftover
+            # span state on this thread
+            tid, parent = ctx if ctx else (None, None)
+            with GLOBAL_TRACER.detached(tid, parent):
+                with GLOBAL_TRACER.span(
+                    "compaction.run",
+                    path=str(fragment.path),
+                    reason=reason,
+                    op_n=fragment.op_n,
+                ):
+                    committed = bool(fragment.compact())
             if committed:
                 # counted ONLY on a real fold: an aborted commit (the
                 # fragment was dropped, or an inline snapshot won the

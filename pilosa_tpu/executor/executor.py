@@ -138,7 +138,7 @@ class _Pending:
     and the per-query resolve so one query's finish() failure cannot
     strand its wave-mates."""
 
-    __slots__ = ("arrays", "finish", "value", "fetched", "route")
+    __slots__ = ("arrays", "finish", "value", "fetched", "route", "audit")
 
     def __init__(
         self,
@@ -154,6 +154,12 @@ class _Pending:
         # readback wave attributes its measured latency to the matching
         # router EWMA so the two paths calibrate independently
         self.route = route
+        # settle-time router-audit record ({route, estimates,
+        # dispatch_s}), completed when the readback wave lands and the
+        # call's full measured cost is known; popped on first use so a
+        # per-query fallback fetch after a poisoned joint readback
+        # cannot double-score the call
+        self.audit: dict | None = None
 
     def resolve_now(self) -> Any:
         self.value = self.finish([np.asarray(a) for a in self.arrays])
@@ -289,8 +295,9 @@ class Executor:
         query,
         calls: "list[Call]",
         shards: list[int] | None,
-    ) -> "list[tuple[str | None, int]]":
-        """(route, work) per call, via the revalidating cache when the
+    ) -> "list[tuple[str | None, int, bool, int]]":
+        """One route spec — ``(route, work, mesh_ok, cold_words)``, the
+        _route tuple — per call, via the revalidating cache when the
         query arrived as a raw string (the serving hot path)."""
         if not isinstance(query, str):
             return [self._route(idx, c, shards) for c in calls]
@@ -325,7 +332,7 @@ class Executor:
         index_name: str,
         query: str | list[Call],
         shards: list[int] | None = None,
-        routes: "list[tuple[str | None, int]] | None" = None,
+        routes: "list[tuple[str | None, int, bool, int]] | None" = None,
     ) -> list[Any]:
         results = self.dispatch(index_name, query, shards, routes=routes)
         pending = [r for r in results if isinstance(r, _Pending)]
@@ -343,7 +350,7 @@ class Executor:
         index_name: str,
         query: str | list[Call],
         shards: list[int] | None = None,
-        routes: "list[tuple[str | None, int]] | None" = None,
+        routes: "list[tuple[str | None, int, bool, int]] | None" = None,
     ) -> list[Any]:
         """Issue every call WITHOUT the readback wave — aggregates come
         back as unresolved ``_Pending``s. This is the enqueue half the
@@ -356,9 +363,10 @@ class Executor:
         histogram-timed (the readback wave is timed separately:
         pipelining means a call's device time is not attributable to its
         own dispatch).  ``routes`` optionally carries per-call
-        ``(route, work)`` pairs a caller (the wave scheduler's
-        batchability check) already computed, so the hot path doesn't
-        pay the work estimation twice."""
+        ``(route, work, mesh_ok, cold_words)`` specs a caller (the wave
+        scheduler's batchability check) already computed, so the hot
+        path doesn't pay the work estimation twice; the trailing
+        elements feed the settle-time router audit."""
         idx = self.holder.index(index_name)
         if idx is None:
             raise ExecutionError(f"index {index_name!r} not found")
@@ -370,7 +378,7 @@ class Executor:
         results = []
         for i, c in enumerate(calls):
             t0 = time.perf_counter()
-            route, work = routes[i]
+            route, work = routes[i][0], routes[i][1]
             with GLOBAL_TRACER.span(f"executor.{c.name}", index=index_name):
                 results.append(
                     self._execute_call(idx, c, shards, lazy=True, route=route)
@@ -383,6 +391,29 @@ class Executor:
                     # throughput/overhead, device/mesh samples their
                     # respective dispatch costs
                     self.router.observe(route, work, elapsed)
+                if work > 0 and self.router.audit.enabled:
+                    # settle-time decision audit: snapshot every
+                    # candidate's estimate NOW (the decision's inputs);
+                    # host calls score immediately — their elapsed IS
+                    # the full cost — while device/mesh pendings carry
+                    # the record to the readback wave, where the
+                    # measured cost completes (Executor.fetch)
+                    spec = routes[i]
+                    est = self._candidate_costs(
+                        route,
+                        work,
+                        spec[2] if len(spec) > 2 else False,
+                        spec[3] if len(spec) > 3 else 0,
+                    )
+                    res = results[-1]
+                    if isinstance(res, _Pending):
+                        res.audit = {
+                            "route": route,
+                            "estimates": est,
+                            "dispatch_s": elapsed,
+                        }
+                    else:
+                        self.router.audit.record(route, est, elapsed)
                 if self.stats is not None:
                     self.stats.count("queries_routed", tags={"path": route})
                 if route == "mesh" and prof is not None:
@@ -423,6 +454,20 @@ class Executor:
         # shared wave's cost is what each path's queries actually paid
         for path in {p.route for p in pending}:
             self.router.observe_readback(elapsed, path=path)
+        # complete the settle-time audit records: each pending call's
+        # measured cost is its own dispatch plus its share of the one
+        # transfer the wave paid (mirroring the cost model's amortized
+        # readback term). Records pop on first use so the per-query
+        # fallback fetch after a poisoned joint readback can't
+        # double-score a call.
+        share = elapsed / len(pending)
+        for p in pending:
+            rec = p.audit
+            if rec is not None:
+                p.audit = None
+                self.router.audit.record(
+                    rec["route"], rec["estimates"], rec["dispatch_s"] + share
+                )
         if self.stats is not None:
             self.stats.timing("executor_readback_seconds", elapsed)
         return elapsed
@@ -443,21 +488,25 @@ class Executor:
 
     # ------------------------------------------------------------ routing
     def _route(self, idx: Index, call: Call, shards: list[int] | None):
-        """(route, estimated_work_words) for one top-level call.  Writes
-        route None (no engine choice to make); Rows is metadata-only and
-        always serves host-side.  Reads go through the cost router —
-        decision memoized per plan key (executor/router.py) — which picks
-        among host, the single-program device path, and (when a
-        multi-device MeshContext is attached and the call tree compiles
-        to mesh programs) the explicit-SPMD mesh path."""
+        """(route, estimated_work_words, mesh_ok, cold_upload_words)
+        for one top-level call.  Writes route None (no engine choice to
+        make); Rows is metadata-only and always serves host-side.
+        Reads go through the cost router — decision memoized per plan
+        key (executor/router.py) — which picks among host, the
+        single-program device path, and (when a multi-device
+        MeshContext is attached and the call tree compiles to mesh
+        programs) the explicit-SPMD mesh path.  The trailing elements
+        carry the decision INPUTS forward so the settle-time audit and
+        EXPLAIN can rebuild every candidate's cost without re-walking
+        the tree."""
         c, sh = call, shards
         while c.name == "Options" and len(c.children) == 1:
             sh = c.arg("shards", sh)
             c = c.children[0]
         if c.name in WRITE_CALLS:
-            return None, 0
+            return None, 0, False, 0
         if c.name == "Rows":
-            return "host", 0
+            return "host", 0, False, 0
         n = len(sh) if sh is not None else max(1, len(idx.available_shards()))
         work = estimate_words(idx, c, n)
         if self.router.mode in ("host", "device"):
@@ -481,7 +530,7 @@ class Executor:
                 mode = "device"
                 if self.compiler.mesh_engine is not None:
                     self.compiler.mesh_engine.note_fallback()
-            return mode, work
+            return mode, work, mesh_ok, cold_words
         return (
             self.router.decide(
                 (idx.name, n, repr(c)),
@@ -490,10 +539,31 @@ class Executor:
                 device_extra_words=cold_words,
             ),
             work,
+            mesh_ok,
+            cold_words,
         )
 
+    def _candidate_costs(
+        self, route: str, work: int, mesh_ok: bool, cold_words: int
+    ) -> dict:
+        """Modeled cost in seconds for every candidate path of one call
+        — the decision's inputs, snapshotted for the settle-time audit
+        and the EXPLAIN cost table.  Mesh appears only when it was a
+        real candidate (eligible and multi-device) or was actually
+        chosen (pinned mode)."""
+        r = self.router
+        extra_s = cold_words / r._host_wps() if cold_words else 0.0
+        costs = {
+            "host": r.host_cost(work),
+            "device": r.device_cost(work) + extra_s,
+        }
+        if (mesh_ok and r.mesh_devices > 1) or route == "mesh":
+            costs["mesh"] = r.mesh_cost(work) + extra_s
+        return costs
+
     def _residency_info(
-        self, idx: Index, call: Call, shards: list[int] | None
+        self, idx: Index, call: Call, shards: list[int] | None,
+        detail: list | None = None,
     ) -> tuple[bool, int]:
         """(touches_tiered_field, cold_upload_words) for one call tree.
 
@@ -525,12 +595,31 @@ class Executor:
         def leaf(field: Field, view_name: str, row_id) -> None:
             nonlocal tiered, cold
             if not over(field, view_name):
+                if detail is not None:
+                    detail.append(
+                        {
+                            "field": field.name,
+                            "view": view_name,
+                            "row": row_id,
+                            "class": "in-budget",
+                        }
+                    )
                 return
             tiered = True
-            if not stacks.tiered_resident(
+            resident = stacks.tiered_resident(
                 idx, field, view_name, shard_list, row_id
-            ):
+            )
+            if not resident:
                 cold += unit
+            if detail is not None:
+                detail.append(
+                    {
+                        "field": field.name,
+                        "view": view_name,
+                        "row": row_id,
+                        "class": "resident" if resident else "cold",
+                    }
+                )
 
         def walk(c: Call) -> None:
             nonlocal tiered, cold
@@ -541,11 +630,25 @@ class Executor:
                     if f is not None and over(f, VIEW_BSI):
                         tiered = True
                         need = BSI_OFFSET + f.bit_depth
+                        cold_slices = 0
                         for d in range(need):
                             if not stacks.tiered_resident(
                                 idx, f, VIEW_BSI, shard_list, d
                             ):
                                 cold += unit
+                                cold_slices += 1
+                        if detail is not None:
+                            detail.append(
+                                {
+                                    "field": f.name,
+                                    "view": VIEW_BSI,
+                                    "slices": need,
+                                    "coldSlices": cold_slices,
+                                    "class": (
+                                        "cold" if cold_slices else "resident"
+                                    ),
+                                }
+                            )
                     return
                 fa = c.field_arg()
                 if fa is not None:
@@ -569,11 +672,26 @@ class Executor:
                     f, VIEW_BSI
                 ):
                     tiered = True
-                    for d in range(BSI_OFFSET + f.bit_depth):
+                    need = BSI_OFFSET + f.bit_depth
+                    cold_slices = 0
+                    for d in range(need):
                         if not stacks.tiered_resident(
                             idx, f, VIEW_BSI, shard_list, d
                         ):
                             cold += unit
+                            cold_slices += 1
+                    if detail is not None:
+                        detail.append(
+                            {
+                                "field": f.name,
+                                "view": VIEW_BSI,
+                                "slices": need,
+                                "coldSlices": cold_slices,
+                                "class": (
+                                    "cold" if cold_slices else "resident"
+                                ),
+                            }
+                        )
             for ch in c.children:
                 walk(ch)
             filt = c.arg("filter")
@@ -613,8 +731,98 @@ class Executor:
             raise ExecutionError(f"index {index_name!r} not found")
         calls = parse(query) if isinstance(query, str) else query
         first = calls[0] if isinstance(calls, list) else calls
-        route, _work = self._route(idx, first, shards)
+        route = self._route(idx, first, shards)[0]
         return route or "write"
+
+    def explain_call(
+        self, idx: Index, call: Call, shards: list[int] | None
+    ) -> dict:
+        """The EXPLAIN plan for one top-level call — every decision the
+        serving path would make, WITHOUT executing anything: the router
+        cost table per candidate path, the residency classification of
+        every touched row range, the mesh supportability verdict, and
+        the work estimate behind them all.  Metadata-only by
+        construction (the same fragment/schema probes the router's hot
+        path uses); nothing here touches JAX."""
+        c, sh = call, shards
+        while c.name == "Options" and len(c.children) == 1:
+            sh = c.arg("shards", sh)
+            c = c.children[0]
+        if c.name in WRITE_CALLS:
+            return {"call": c.name, "route": "write"}
+        if c.name == "Rows":
+            return {
+                "call": c.name,
+                "route": "host",
+                "note": "metadata-only call; always served host-side",
+            }
+        n = len(sh) if sh is not None else max(1, len(idx.available_shards()))
+        work = estimate_words(idx, c, n)
+        res_detail: list = []
+        tiered, cold_words = self._residency_info(idx, c, sh, detail=res_detail)
+        # mesh supportability, verdict + reason (docs/spmd.md)
+        mesh_attached = self.compiler.mesh_engine is not None
+        geometry_ok = mesh_attached and self.compiler.mesh_mode(n) is not None
+        programs_ok = False
+        if geometry_ok:
+            from pilosa_tpu.parallel.mesh import mesh_supported
+
+            programs_ok = mesh_supported(c)
+        multi_device = self.router.mesh_devices > 1
+        mesh_ok = geometry_ok and programs_ok and not tiered and multi_device
+        if not mesh_attached:
+            mesh_reason = "no mesh engine attached"
+        elif not multi_device:
+            mesh_reason = "single device — mesh path disabled"
+        elif not geometry_ok:
+            mesh_reason = "shard/word geometry does not place onto the mesh"
+        elif not programs_ok:
+            mesh_reason = "call tree contains mesh-fallback calls"
+        elif tiered:
+            mesh_reason = (
+                "tiered residency pins to the single-program device path"
+            )
+        else:
+            mesh_reason = "supported"
+        # the route the router takes RIGHT NOW — same decision inputs
+        # and memo path as _route, but WITHOUT re-running the residency
+        # and mesh-supportability walks this function already did (and
+        # without _route's fallback-counter side effect, which counts
+        # real serving fallbacks only)
+        if self.router.mode != "auto":
+            route = self.router.mode
+            if route == "mesh" and not mesh_ok:
+                route = "device"
+        else:
+            route = self.router.decide(
+                (idx.name, n, repr(c)),
+                work,
+                mesh_ok=mesh_ok,
+                device_extra_words=cold_words,
+            )
+        costs = self._candidate_costs(route, work, mesh_ok, cold_words)
+        return {
+            "call": c.name,
+            "route": route,
+            "routeMode": self.router.mode,
+            "estimatedWorkWords": work,
+            "crossoverWords": self.router.crossover_words(),
+            "candidates": {
+                path: {"estimatedSeconds": s, "chosen": path == route}
+                for path, s in sorted(costs.items())
+            },
+            "residency": {
+                "mode": self.compiler.stacks.residency_mode(),
+                "tiered": tiered,
+                "coldUploadWords": cold_words,
+                "rowRanges": res_detail,
+            },
+            "mesh": {
+                "supported": mesh_ok,
+                "reason": mesh_reason,
+                "meshDevices": self.router.mesh_devices,
+            },
+        }
 
     def _execute_call(
         self,

@@ -51,9 +51,128 @@ import numpy as np
 from pilosa_tpu.core import FIELD_INT, VIEW_STANDARD
 from pilosa_tpu.pql import Call
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
-from pilosa_tpu.utils.stats import Ewma, Histogram
+from pilosa_tpu.utils.stats import DEFAULT_BUCKETS, Ewma, Histogram
 
 ROUTE_MODES = ("auto", "host", "device", "mesh")
+
+# measured cost must exceed another candidate's ESTIMATE by this factor
+# before the settle-time audit calls the decision a misroute — the
+# estimates are models, and flagging every sub-2x disagreement would
+# alert on noise instead of calibration drift
+_MISROUTE_MARGIN = 2.0
+
+
+class RouterAudit:
+    """Settle-time scoring of routing decisions against measured
+    reality (PIMDAL's operator-level cost accounting, arXiv 2504.01948,
+    is the shape: every operator's estimate is compared with its
+    measured cost so a drifting model is a signal, not a mystery).
+
+    At dispatch the executor snapshots the cost estimates for EVERY
+    candidate path; at settle (host calls immediately, device/mesh
+    calls when their readback wave lands) the measured cost scores the
+    chosen route:
+
+    - ``router_estimate_error_ratio`` histogram per path — measured /
+      estimated for the chosen route (1.0 = perfectly calibrated);
+    - ``router_misroute_total{chosen,better}`` — settled calls whose
+      measured cost exceeded another candidate's estimate by the
+      misroute margin: the model said "chosen is cheapest" and reality
+      disagreed by enough to have changed the decision;
+    - the ``/debug/vars`` ``routerAudit`` section — per-path sample
+      counts, error-ratio EWMAs and quantiles, and the misroute matrix,
+      so a mis-calibrated crossover is an alertable drift signal
+      instead of a silent regression.
+
+    Lives on the QueryRouter so calibration history survives executor
+    rebuilds (the late mesh attach) exactly like the EWMAs do."""
+
+    def __init__(self, stats=None, enabled: bool = True, alpha: float = 0.1):
+        self.stats = stats
+        self.enabled = bool(enabled)
+        self._lock = threading.Lock()
+        self._ratio_hists: dict[str, Histogram] = {}
+        self._ratio_ewmas: dict[str, Ewma] = {}
+        self._samples: dict[str, int] = {}
+        self._misroutes: dict[tuple[str, str], int] = {}
+        self._alpha = alpha
+
+    def record(
+        self, route: str, estimates: dict, measured_s: float
+    ) -> None:
+        """Score one settled call: ``estimates`` maps every candidate
+        path to its modeled cost in seconds at decision time;
+        ``measured_s`` is what the chosen ``route`` actually cost."""
+        if not self.enabled or measured_s <= 0:
+            return
+        est = estimates.get(route)
+        if not est or est <= 0:
+            return
+        ratio = measured_s / est
+        with self._lock:
+            hist = self._ratio_hists.get(route)
+            if hist is None:
+                hist = self._ratio_hists[route] = Histogram()
+            ewma = self._ratio_ewmas.get(route)
+            if ewma is None:
+                ewma = self._ratio_ewmas[route] = Ewma(self._alpha)
+            self._samples[route] = self._samples.get(route, 0) + 1
+        hist.observe(ratio)
+        ewma.update(ratio)
+        if self.stats is not None:
+            self.stats.observe(
+                "router_estimate_error_ratio",
+                ratio,
+                tags={"path": route},
+                buckets=DEFAULT_BUCKETS,
+            )
+        # misroute check: another candidate's ESTIMATE undercuts what
+        # the chosen path measurably cost, by enough margin that the
+        # router would have decided differently had it known
+        better, best_est = None, None
+        for path, e in estimates.items():
+            if path == route or e is None or e <= 0:
+                continue
+            if best_est is None or e < best_est:
+                better, best_est = path, e
+        if better is not None and measured_s > best_est * _MISROUTE_MARGIN:
+            with self._lock:
+                key = (route, better)
+                self._misroutes[key] = self._misroutes.get(key, 0) + 1
+            if self.stats is not None:
+                self.stats.count(
+                    "router_misroute_total",
+                    tags={"chosen": route, "better": better},
+                )
+
+    def snapshot(self) -> dict:
+        """The ``/debug/vars`` ``routerAudit`` section."""
+        with self._lock:
+            samples = dict(self._samples)
+            misroutes = dict(self._misroutes)
+            hists = dict(self._ratio_hists)
+            ewmas = {k: e.value for k, e in self._ratio_ewmas.items()}
+        per_path = {}
+        for path, n in samples.items():
+            h = hists.get(path)
+            per_path[path] = {
+                "samples": n,
+                # the drift signal: sustained departure from 1.0 means
+                # this path's cost model no longer matches reality
+                "errorRatioEwma": ewmas.get(path),
+                "errorRatioP50": h.percentile(0.5) if h is not None else None,
+                "errorRatioP95": h.percentile(0.95) if h is not None else None,
+            }
+        return {
+            "enabled": self.enabled,
+            "misrouteMargin": _MISROUTE_MARGIN,
+            "perPath": per_path,
+            "misroutes": [
+                {"chosen": c, "better": b, "count": n}
+                for (c, b), n in sorted(misroutes.items())
+            ],
+            "misrouteTotal": sum(misroutes.values()),
+        }
 
 # calibration drift that invalidates memoized decisions
 _DRIFT = 0.25
@@ -77,6 +196,7 @@ class QueryRouter:
         alpha: float = 0.3,
         mesh_dispatch_seed_s: float = 2e-3,
         mesh_readback_seed_s: float = 2e-3,
+        audit_enabled: bool = True,
     ):
         if mode is None:
             mode = os.environ.get("PILOSA_TPU_ROUTE_MODE", "") or "auto"
@@ -140,6 +260,9 @@ class QueryRouter:
             self._snapshots["host_wps"] = self.host_wps.value
         self._observes = 0
         self.decisions = {"host": 0, "device": 0, "mesh": 0}
+        # settle-time decision audit (docs/query-routing.md): lives here
+        # so its history survives executor rebuilds like the EWMAs do
+        self.audit = RouterAudit(stats=stats, enabled=audit_enabled)
 
     # ----------------------------------------------------------- calibration
     def _calibrate_host(self) -> float:

@@ -1529,6 +1529,12 @@ class Cluster:
                     else:
                         replan.setdefault(target.id, []).append(s)
                         node_by_id.setdefault(target.id, target)
+                if prof is not None:
+                    # per-query failover attribution: the evidence the
+                    # flight recorder retains names the failed peer and
+                    # where each shard group went (docs/fault-tolerance.md)
+                    for to_id, moved in replan.items():
+                        prof.note_failover(node_id, to_id, moved)
                 if lost:
                     qctx = resilience.current_query_context()
                     if qctx is not None and qctx.allow_partial:

@@ -39,6 +39,7 @@ import random
 import threading
 import time
 
+from pilosa_tpu.utils import tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 # fan-out hops forward the REMAINING budget (milliseconds, integer) in
@@ -511,6 +512,12 @@ class ResilientClient:
                     raise
                 if self._stats is not None:
                     self._stats.count("rpc_retries", tags={"method": name})
+                prof = tracing.current_profile()
+                if prof is not None:
+                    # per-query retry attribution: the flight recorder /
+                    # ?profile=true evidence names WHICH hop retried,
+                    # not just that some global counter moved
+                    prof.note_retry(name, uri, attempt + 1)
                 with GLOBAL_TRACER.span(
                     "rpc.retry", method=name, attempt=attempt + 1
                 ):

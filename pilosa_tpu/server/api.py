@@ -240,6 +240,52 @@ class API:
             durable.ack_barrier()
         return self.build_response(results)
 
+    def explain(self, index: str, pql: str, shards: list[int] | None = None) -> dict:
+        """EXPLAIN (plan only — docs/observability.md): the decisions
+        the serving path would make for this query, without executing
+        it — per-call router cost tables over every candidate path,
+        residency classification of touched row ranges, mesh
+        supportability verdicts, and the wave scheduler's batchability
+        prediction.  ``?explain=analyze`` runs the query too and the
+        HTTP layer merges measured actuals next to these estimates."""
+        from pilosa_tpu.executor.executor import WRITE_CALLS, unwrap_options
+        from pilosa_tpu.pql import parse
+
+        calls = parse(pql) if isinstance(pql, str) else pql
+        idx = self.executor.holder.index(index)
+        if idx is None:
+            raise ExecutionError(f"index {index!r} not found")
+        plans = [self.executor.explain_call(idx, c, shards) for c in calls]
+        has_write = any(unwrap_options(c).name in WRITE_CALLS for c in calls)
+        any_device = any(p.get("route") in ("device", "mesh") for p in plans)
+        if self.scheduler.mode == "off":
+            batchable, why = False, "batch-mode is off"
+        elif has_write:
+            batchable, why = False, "query contains writes (never coalesced)"
+        elif not any_device:
+            batchable, why = False, (
+                "no device/mesh-routed call — host-routed queries bypass "
+                "the wave window"
+            )
+        else:
+            batchable, why = True, (
+                "device-routed reads ride shared dispatch/readback waves"
+            )
+        router = self.executor.router
+        return {
+            "index": index,
+            "query": pql if isinstance(pql, str) else repr(pql),
+            "routeMode": router.mode,
+            "crossoverWords": router.crossover_words(),
+            "waveScheduler": {
+                "mode": self.scheduler.mode,
+                "batchable": batchable,
+                "reason": why,
+                "occupancyEwma": router.wave_occupancy.value,
+            },
+            "calls": plans,
+        }
+
     def build_response(self, results: list[Any]) -> dict:
         """Assemble the QueryResponse dict; Options(columnAttrs=true)
         results contribute response-level columnAttrs sets (reference:

@@ -129,7 +129,8 @@ class _BufferedHandler(Handler):
     response accumulates in ``wfile`` (a BytesIO) for the loop to write
     back; ``close_connection`` reports the keep-alive decision."""
 
-    def __init__(self, server, raw: bytes, client_address, deadline=None):
+    def __init__(self, server, raw: bytes, client_address, deadline=None,
+                 admission_wait: float | None = None):
         # deliberately NOT calling super().__init__: the socketserver
         # constructor runs the blocking per-connection protocol; this
         # shim replaces exactly that part
@@ -140,6 +141,10 @@ class _BufferedHandler(Handler):
         # admission-time deadline: _query_context prefers this over
         # re-parsing the header so queue wait counts against the budget
         self.admission_deadline = deadline
+        # measured admission-lane wait for THIS request: the profile
+        # and the flight recorder attribute queue time vs query time
+        # from it (docs/observability.md)
+        self.admission_wait_s = admission_wait
         self.close_connection = True
         self.requestline = ""
         self.request_version = ""
@@ -608,9 +613,9 @@ class EventHTTPServer(_ServerCore):
             await adm.sem.acquire()
         finally:
             adm.waiting -= 1
+        wait_s = time.monotonic() - t0
         self.stats.timing(
-            "admission_wait_seconds", time.monotonic() - t0,
-            tags={"class": cls},
+            "admission_wait_seconds", wait_s, tags={"class": cls},
         )
         adm.in_flight += 1
         try:
@@ -639,7 +644,7 @@ class EventHTTPServer(_ServerCore):
             )
             payload, close = await loop.run_in_executor(
                 self._pool, self._run_request, raw, writer, deadline,
-                direct_ok,
+                direct_ok, wait_s,
             )
         finally:
             adm.in_flight -= 1
@@ -653,7 +658,8 @@ class EventHTTPServer(_ServerCore):
         return not close
 
     def _run_request(self, raw: bytes, writer, deadline,
-                     direct_ok: bool = False) -> tuple[bytes, bool]:
+                     direct_ok: bool = False,
+                     admission_wait: float | None = None) -> tuple[bytes, bool]:
         """Worker-thread half: run the buffered request through the
         route table; returns (unsent response bytes, close_connection).
 
@@ -672,7 +678,7 @@ class EventHTTPServer(_ServerCore):
         returns to the loop."""
         peer = writer.get_extra_info("peername") or ("", 0)
         try:
-            h = _BufferedHandler(self, raw, peer, deadline)
+            h = _BufferedHandler(self, raw, peer, deadline, admission_wait)
             out = h.wfile.getvalue()
             close = h.close_connection
             if not out:

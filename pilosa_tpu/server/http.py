@@ -26,6 +26,8 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time
+from datetime import datetime, timezone
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
@@ -62,6 +64,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/metrics$"), "metrics"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
+    ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
     ("GET", re.compile(r"^/debug/faults$"), "debug_faults"),
     ("POST", re.compile(r"^/debug/faults$"), "debug_faults_set"),
     ("DELETE", re.compile(r"^/debug/faults$"), "debug_faults_clear"),
@@ -77,6 +80,20 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
 ]
+
+
+def snapshot_envelope(section: dict) -> dict:
+    """Uniform freshness envelope for every ``/debug/vars`` section:
+    ``snapshotMonotonicS`` (this process's monotonic clock — diff two
+    scrapes to age a snapshot without NTP hazards) and ``generatedAt``
+    (ISO-8601 UTC wall time, for correlating with external logs; never
+    used in arithmetic).  Sections used to carry inconsistent timestamp
+    fields — some wall-clock, most absent — so "how stale is this
+    snapshot" had no uniform answer."""
+    out = dict(section)
+    out["snapshotMonotonicS"] = time.monotonic()
+    out["generatedAt"] = datetime.now(timezone.utc).isoformat()  # pilosa: allow(wall-clock)
+    return out
 
 
 class Handler(BaseHTTPRequestHandler):
@@ -327,8 +344,6 @@ class Handler(BaseHTTPRequestHandler):
         )
 
     def h_query(self, index: str) -> None:
-        import time
-
         if not self._gate():
             return
         body = self._body()
@@ -343,19 +358,57 @@ class Handler(BaseHTTPRequestHandler):
             "true",
             "1",
         )
+        explain = self.query_params.get("explain", [""])[0].lower()
+        if explain in ("true", "1", "plan"):
+            # EXPLAIN (docs/observability.md): the plan alone — router
+            # cost table per candidate path, residency classification,
+            # mesh verdict, wave batchability — NOTHING executes
+            self._json({"explain": self.api.explain(index, pql, shards)})
+            return
+        # EXPLAIN ANALYZE is JSON-only, like ?profile=true — a protobuf
+        # QueryResponse has no explain slot, so don't pay the plan walk
+        # for a payload that could never be delivered
+        analyze = explain == "analyze" and not proto
+        # EXPLAIN ANALYZE snapshots the plan BEFORE execution so the
+        # estimates it shows are the ones this very run decided with
+        # (execution feeds the calibration EWMAs, moving them)
+        plan = self.api.explain(index, pql, shards) if analyze else None
         qctx = self._query_context()
         t0 = time.perf_counter()
+        err: BaseException | None = None
+        resp = None
         # the profile collector is always installed (a handful of dict
         # appends per query) so the long-query log can name the slow
-        # shard group even when the client didn't ask for a profile
+        # shard group even when the client didn't ask for a profile —
+        # and so the flight recorder has full evidence at settle time
+        # for a query nobody marked in advance
         with resilience.use_query_context(qctx):
             with tracing.profile_query() as prof:
                 with self.stats.timer("query_seconds", tags={"index": index}):
                     with GLOBAL_TRACER.span("pql.query", index=index) as sp:
                         prof.trace_id = sp.trace_id
-                        resp = self.server.query_router(index, pql, shards)
+                        try:
+                            resp = self.server.query_router(index, pql, shards)
+                        except Exception as e:  # noqa: BLE001 — held for
+                            # the flight recorder's settle decision
+                            # (errored queries retain), re-raised below
+                            # into _guarded's canonical status mapping
+                            err = e
         elapsed = time.perf_counter() - t0
         prof.total_seconds = elapsed
+        wait = getattr(self, "admission_wait_s", None)
+        if wait is not None:
+            # the event front end's admission-lane wait for THIS request
+            # (docs/serving.md): the queue-or-query attribution
+            prof.admission_wait = wait
+        if qctx.deadline is not None:
+            prof.deadline = {
+                "budgetS": qctx.deadline.budget_s,
+                "remainingS": qctx.deadline.remaining(),
+            }
+        self._flightrec_settle(index, pql, prof, elapsed, err)
+        if err is not None:
+            raise err
         slow = self.server.long_query_time
         if slow > 0 and elapsed >= slow:
             worst = prof.slowest()
@@ -378,7 +431,78 @@ class Handler(BaseHTTPRequestHandler):
             if want_profile:
                 resp = dict(resp)
                 resp["profile"] = prof.to_json()
+            if analyze:
+                resp = dict(resp)
+                resp["explain"] = self._merge_explain_actuals(plan, prof)
             self._json(resp)
+
+    def _flightrec_settle(
+        self, index: str, pql: str, prof, elapsed: float,
+        err: BaseException | None,
+    ) -> None:
+        """Hand the settled query to the flight recorder — the evidence
+        thunk (full profile + the trace's buffered spans) is only paid
+        when the recorder decides to retain."""
+        rec = getattr(self.server, "flightrec", None)
+        if rec is None or not rec.enabled:
+            return
+        if prof.calls:
+            call_type = prof.calls[0]["call"]
+        else:
+            call_type = pql.split("(", 1)[0].strip() or "?"
+
+        def entry() -> dict:
+            return {
+                "traceId": prof.trace_id,
+                "index": index,
+                "query": pql[:500],
+                "node": self.server.node_id,
+                "profile": prof.to_json(),
+                "spans": (
+                    GLOBAL_TRACER.spans_for_trace(prof.trace_id)
+                    if prof.trace_id
+                    else []
+                ),
+            }
+
+        rec.settle(call_type, elapsed, entry, error=err)
+
+    @staticmethod
+    def _merge_explain_actuals(plan: dict, prof) -> dict:
+        """EXPLAIN ANALYZE: attach each call's measured actuals next to
+        the estimates the plan carries, plus the per-path error ratio
+        for the route that actually ran."""
+        actuals = [e for e in prof.calls if e["call"] != "_readback"]
+        readback = sum(
+            e["seconds"] for e in prof.calls if e["call"] == "_readback"
+        )
+        dev_calls = sum(
+            1 for e in actuals if e.get("route") in ("device", "mesh")
+        )
+        for p, actual in zip(plan.get("calls", []), actuals):
+            p["actualSeconds"] = actual["seconds"]
+            actual_route = actual.get("route") or p.get("route")
+            p["actualRoute"] = actual_route
+            measured = actual["seconds"]
+            if actual_route in ("device", "mesh") and readback:
+                # the shared readback wave's cost, split across the
+                # device-routed calls that rode it — same attribution
+                # the router audit uses
+                measured += readback / max(1, dev_calls)
+            chosen = p.get("candidates", {}).get(actual_route)
+            if chosen and chosen.get("estimatedSeconds"):
+                chosen["measuredSeconds"] = measured
+                chosen["errorRatio"] = (
+                    measured / chosen["estimatedSeconds"]
+                )
+        plan["actualTotalSeconds"] = prof.total_seconds
+        if readback:
+            plan["actualReadbackSeconds"] = readback
+        if prof.wave is not None:
+            plan["wave"] = prof.wave
+        if prof.admission_wait is not None:
+            plan["admissionWaitSeconds"] = prof.admission_wait
+        return plan
 
     def h_create_index(self, index: str) -> None:
         body = self._json_body()
@@ -503,36 +627,86 @@ class Handler(BaseHTTPRequestHandler):
 
     def h_debug_vars(self) -> None:
         out = self.stats.expvar()
+        # every section below carries the uniform snapshotMonotonicS +
+        # generatedAt envelope (snapshot_envelope): sections used to mix
+        # wall-clock timestamps with none at all, so snapshot staleness
+        # had no consistent answer
         # device-cache effectiveness counters (tests assert the write
         # path stays incremental; operators read them here)
-        out["stackCache"] = self.api.executor.compiler.stacks.stats_snapshot()
+        out["stackCache"] = snapshot_envelope(
+            self.api.executor.compiler.stacks.stats_snapshot()
+        )
         # tiered compressed residency: container tiers, hot/cold row
         # promotion + demotion, per-container resident bytes
         # (docs/device-residency.md)
-        out["deviceResidency"] = (
+        out["deviceResidency"] = snapshot_envelope(
             self.api.executor.compiler.stacks.residency_snapshot()
         )
         # live cost-router calibration: mode, crossover, and the EWMAs
         # behind every host/device decision (docs/query-routing.md)
-        out["queryRouting"] = self.api.executor.router.snapshot()
+        out["queryRouting"] = snapshot_envelope(
+            self.api.executor.router.snapshot()
+        )
+        # settle-time router-decision audit: per-path estimate-error
+        # drift and the misroute matrix (docs/query-routing.md)
+        out["routerAudit"] = snapshot_envelope(
+            self.api.executor.router.audit.snapshot()
+        )
         # cross-query wave coalescing: waves, occupancy, dedup hits
         # (docs/query-batching.md)
-        out["queryBatching"] = self.api.scheduler.snapshot()
+        out["queryBatching"] = snapshot_envelope(self.api.scheduler.snapshot())
         # explicit-SPMD mesh execution: device count, mesh geometry,
         # per-program-family call counts, fallbacks (docs/spmd.md)
-        out["meshExecution"] = self.api.executor.compiler.mesh_snapshot()
+        out["meshExecution"] = snapshot_envelope(
+            self.api.executor.compiler.mesh_snapshot()
+        )
         # serving front end: connection counts, admission queue state,
         # per-class concurrency limits (docs/serving.md)
-        out["serving"] = self.server.serving_snapshot()
+        out["serving"] = snapshot_envelope(self.server.serving_snapshot())
         # durable write protocol: WAL fsync mode + dirty-file count, and
         # the background compactor's queue/debt state (docs/durability.md)
         from pilosa_tpu.utils import durable
 
-        out["durability"] = {
-            "wal": durable.wal_snapshot(),
-            "compaction": self.api.holder.compactor.snapshot(),
-        }
+        out["durability"] = snapshot_envelope(
+            {
+                "wal": durable.wal_snapshot(),
+                "compaction": self.api.holder.compactor.snapshot(),
+            }
+        )
         self._json(out)
+
+    def h_debug_flightrec(self) -> None:
+        """The flight recorder's surface (docs/observability.md):
+        retained slow/errored query evidence.  ``?trace_id=`` returns
+        one entry with the full profile and spans;
+        ``?trace_id=&format=perfetto`` (or ``chrome``) exports the
+        retained spans as Chrome trace-event JSON — loadable in
+        Perfetto even after the live tracer ring rotated them out."""
+        rec = getattr(self.server, "flightrec", None)
+        if rec is None:
+            self._json({"error": "flight recorder not wired"}, code=404)
+            return
+        trace_id = self.query_params.get("trace_id", [""])[0]
+        fmt = self.query_params.get("format", [""])[0]
+        if trace_id:
+            if fmt in ("perfetto", "chrome"):
+                out = rec.perfetto(trace_id, node_id=self.server.node_id)
+                if out is None:
+                    self._json(
+                        {"error": f"trace {trace_id!r} not retained"}, code=404
+                    )
+                    return
+                self._json(out)
+                return
+            e = rec.entry(trace_id)
+            if e is None:
+                self._json(
+                    {"error": f"trace {trace_id!r} not retained"}, code=404
+                )
+                return
+            self._json(e)
+            return
+        self._json(rec.snapshot())
 
     def h_debug_traces(self) -> None:
         """Recent spans, or one trace by id. ``?trace_id=`` filters to a
@@ -705,6 +879,17 @@ class _ServerCore:
         from pilosa_tpu.utils.log import Logger
 
         self.log = Logger().log
+        # always-on flight recorder (docs/observability.md): tail-based
+        # retention of slow/errored query evidence, served by GET
+        # /debug/flightrec.  Default-constructed so embedded/standalone
+        # listeners record too; Server.open replaces it with the
+        # config-sized one.  The log thunk indirects through self so the
+        # runtime Server's later log swap is picked up.
+        from pilosa_tpu.utils.flightrec import FlightRecorder
+
+        self.flightrec = FlightRecorder(
+            stats=self.stats, log=lambda msg: self.log(msg)
+        )
         self.extra_routes: dict = {}
         # sync queries land in the API façade, which hands them to the
         # cross-query wave scheduler (api.scheduler) instead of calling

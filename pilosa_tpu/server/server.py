@@ -90,6 +90,7 @@ class Server:
             crossover_words=self.config.route_crossover_words,
             mesh_dispatch_seed_s=self.config.route_mesh_dispatch_ms / 1e3,
             mesh_readback_seed_s=self.config.route_mesh_readback_ms / 1e3,
+            audit_enabled=self.config.router_audit_enabled,
         )
         # mesh_ctx=None here: MeshContext.auto() initializes the full JAX
         # backend (seconds, or worse on a wedged transport) — that must
@@ -172,6 +173,18 @@ class Server:
             )
             self.http.ssl_context = ctx
         self.http.node_id = self.config.node_id
+        # config-sized flight recorder (docs/observability.md) replaces
+        # the listener's default one; wired to this server's logger so
+        # the structured slow-query line lands in the configured sink
+        from pilosa_tpu.utils.flightrec import FlightRecorder
+
+        self.http.flightrec = FlightRecorder(
+            capacity=self.config.flightrec_entries,
+            min_latency_s=self.config.flightrec_min_ms / 1e3,
+            stats=self.stats,
+            log=self.logger.log,
+            enabled=self.config.flightrec_enabled,
+        )
         self.http.long_query_time = self.config.long_query_time
         self.http.query_timeout_ms = self.config.query_timeout_ms
         self.http.fault_injector = self.fault_injector

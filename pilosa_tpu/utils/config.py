@@ -176,6 +176,21 @@ class Config:
     # fragment, not the sum; <=1 loads serially. Device upload stays
     # lazy (first query per stack) either way.
     holder_load_workers: int = 8
+    # flight recorder (docs/observability.md): always-on tail-based
+    # retention of slow/errored query evidence, served by GET
+    # /debug/flightrec. Disabling it removes the retention decision from
+    # the settle path entirely (the bench's instrumented-off baseline).
+    flightrec_enabled: bool = True
+    # ring-buffer capacity: retained entries past it evict oldest-first
+    flightrec_entries: int = 256
+    # floor under the rolling p95 retention threshold, in milliseconds —
+    # a uniformly fast call type must not retain its own p95 noise
+    flightrec_min_ms: float = 25.0
+    # settle-time router-decision audit (docs/query-routing.md):
+    # router_misroute_total / router_estimate_error_ratio and the
+    # /debug/vars routerAudit drift section; disable for the bench's
+    # instrumented-off baseline
+    router_audit_enabled: bool = True
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -308,6 +323,10 @@ def config_template() -> str:
         "compaction-workers = 1\n"
         "compaction-max-debt = 64\n"
         "holder-load-workers = 8\n"
+        "flightrec-enabled = true\n"
+        "flightrec-entries = 256\n"
+        "flightrec-min-ms = 25.0\n"
+        "router-audit-enabled = true\n"
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

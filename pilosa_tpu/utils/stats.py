@@ -39,6 +39,53 @@ def _count_buckets() -> tuple[float, ...]:
 COUNT_BUCKETS = _count_buckets()
 
 
+# one-line HELP strings for the exposition format, keyed by family name
+# minus the ``pilosa_tpu_`` prefix; families not listed here get a
+# generic line (the metric⇄docs drift analyzer rule keeps the REAL
+# catalog in docs/observability.md complete — this dict only feeds the
+# human-readable scrape output)
+_METRIC_HELP = {
+    "http_requests": "requests per HTTP route",
+    "http_request_seconds": "per-route HTTP handler latency",
+    "query_seconds": "end-to-end /index/{i}/query latency",
+    "executor_call_seconds": "per-PQL-call dispatch time in the local executor",
+    "executor_readback_seconds": "the one device-to-host readback wave per request",
+    "fanout_rpc_seconds": "coordinator-to-peer query RPC latency per leg",
+    "fanout_batch_rpc_seconds": "coalesced multi-query fan-out RPC latency",
+    "internal_query_batch_seconds": "serve time of /internal/query/batch",
+    "queries_routed": "read calls per engine picked by the cost router",
+    "queries_served": "read legs this node executed",
+    "queries_gated": "queries arriving during the device-probe window",
+    "queries_deduped": "queries answered by single-flight dedup",
+    "queries_partial": "queries answered with partial results",
+    "queries_rejected": "requests shed by admission control",
+    "queries_per_wave": "occupancy of cross-query device waves",
+    "wave_flush_reason": "why each wave dispatched",
+    "legs_per_batch_rpc": "legs coalesced per multi-query fan-out RPC",
+    "legs_failed_over": "fan-out legs re-planned onto a surviving replica",
+    "rpc_retries": "idempotent RPC retry attempts",
+    "rpc_backpressure": "RPCs answered 429 by a peer's admission control",
+    "breaker_state": "per-peer circuit breaker state (0 closed, 1 open, 2 half-open)",
+    "connections_open": "open HTTP connections on the event front end",
+    "connections_accepted": "accepted HTTP connections",
+    "connections_aborted_midbody": "connections torn down mid-request-body",
+    "admission_queue_depth": "admission queue depth at arrival, per class",
+    "admission_wait_seconds": "time spent queued in admission, per class",
+    "eventloop_unhandled_exceptions": "exceptions nothing awaited (bugs)",
+    "compaction_pending": "queued plus in-flight background compactions",
+    "compactions_total": "completed background compactions",
+    "compactions_failed": "compactions aborted by a disk error",
+    "compactions_crashed": "compactions torn by an injected crash",
+    "stack_evictions_total": "device-cache evictions under the byte budget",
+    "rows_promoted": "rows promoted into tiered compressed residency",
+    "rows_demoted": "resident rows LRU-demoted back to host-only serving",
+    "residency_bytes": "device bytes held by tiered container stores",
+    "flightrec_retained_total": "queries retained by the flight recorder",
+    "router_misroute_total": "settled queries whose measured cost exceeded another route's estimate",
+    "router_estimate_error_ratio": "measured over estimated cost for the chosen route",
+}
+
+
 class Ewma:
     """Exponentially weighted moving average — the calibration primitive
     behind the query router's online crossover (executor/router.py): the
@@ -173,15 +220,25 @@ class StatsClient:
                 hist = self._timings[key] = Histogram()
         hist.observe(seconds)
 
-    def observe(self, name: str, value: float, tags: dict | None = None) -> None:
+    def observe(
+        self,
+        name: str,
+        value: float,
+        tags: dict | None = None,
+        buckets: tuple[float, ...] | None = None,
+    ) -> None:
         """Record into a VALUE distribution (e.g. ``queries_per_wave``):
         a real histogram like timing(), but with count-shaped buckets
-        and no seconds unit."""
+        and no seconds unit.  ``buckets`` overrides the boundary set at
+        series creation (e.g. the router audit's error-RATIO
+        distribution needs sub-1.0 resolution the power-of-two count
+        buckets can't give); later calls reuse whatever the series was
+        created with."""
         key = self._key(name, tags)
         with self._lock:
             hist = self._dists.get(key)
             if hist is None:
-                hist = self._dists[key] = Histogram(COUNT_BUCKETS)
+                hist = self._dists[key] = Histogram(buckets or COUNT_BUCKETS)
         hist.observe(value)
 
     def histogram(self, name: str, tags: dict | None = None) -> Histogram | None:
@@ -243,8 +300,28 @@ class StatsClient:
         base = f"{self.prefix}_{name}"
         return base if name.endswith("_seconds") else base + "_seconds"
 
+    @staticmethod
+    def _escape_label(value) -> str:
+        """Exposition-format label-value escaping: backslash, double
+        quote, and newline must be escaped or a value containing any of
+        them corrupts every scrape after it."""
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    def _help_text(self, family: str, kind: str) -> str:
+        base = family[len(self.prefix) + 1 :] if family.startswith(
+            self.prefix + "_"
+        ) else family
+        return _METRIC_HELP.get(base, f"pilosa-tpu {kind} {base}")
+
     def prometheus(self) -> str:
-        """Prometheus text exposition (reference: /metrics). Timers
+        """Prometheus text exposition (reference: /metrics), conformant
+        with the exposition format: one ``# HELP`` + ``# TYPE`` pair per
+        metric family (not per series), label values escaped.  Timers
         expose as real histograms — cumulative ``_bucket{le=...}`` series
         plus ``_sum``/``_count`` — so p95/p99 are PromQL-derivable."""
         lines = []
@@ -255,26 +332,36 @@ class StatsClient:
             dists = sorted(self._dists.items())
 
         def labels(k, extra: str = ""):
-            inner = ",".join(f'{t}="{v}"' for t, v in k[1])
+            inner = ",".join(
+                f'{t}="{self._escape_label(v)}"' for t, v in k[1]
+            )
             if extra:
                 inner = f"{inner},{extra}" if inner else extra
             return "{" + inner + "}" if inner else ""
 
-        for k, v in counters:
-            lines.append(f"# TYPE {self.prefix}_{k[0]} counter")
-            lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
-        for k, v in gauges:
-            lines.append(f"# TYPE {self.prefix}_{k[0]} gauge")
-            lines.append(f"{self.prefix}_{k[0]}{labels(k)} {v}")
         seen_families = set()
+
+        def header(family: str, kind: str) -> None:
+            if family in seen_families:
+                return
+            seen_families.add(family)
+            lines.append(f"# HELP {family} {self._help_text(family, kind)}")
+            lines.append(f"# TYPE {family} {kind}")
+
+        for k, v in counters:
+            family = f"{self.prefix}_{k[0]}"
+            header(family, "counter")
+            lines.append(f"{family}{labels(k)} {v}")
+        for k, v in gauges:
+            family = f"{self.prefix}_{k[0]}"
+            header(family, "gauge")
+            lines.append(f"{family}{labels(k)} {v}")
         # distributions expose under their bare name (no _seconds unit)
         series = [(self._timing_family(k[0]), k, h) for k, h in timings] + [
             (f"{self.prefix}_{k[0]}", k, h) for k, h in dists
         ]
         for family, k, hist in series:
-            if family not in seen_families:
-                seen_families.add(family)
-                lines.append(f"# TYPE {family} histogram")
+            header(family, "histogram")
             for le, cum in hist.cumulative():
                 le_str = "+Inf" if le == float("inf") else f"{le:g}"
                 le_label = labels(k, f'le="{le_str}"')
@@ -331,10 +418,16 @@ class StatsdStats(StatsClient):
         super().timing(name, seconds, tags)
         self._emit(name, self._num(seconds * 1e3), "ms", tags)
 
-    def observe(self, name: str, value: float, tags: dict | None = None) -> None:
+    def observe(
+        self,
+        name: str,
+        value: float,
+        tags: dict | None = None,
+        buckets: tuple[float, ...] | None = None,
+    ) -> None:
         # value distributions (queries_per_wave, legs_per_batch_rpc)
         # emit as dogstatsd histograms — "every update" includes these
-        super().observe(name, value, tags)
+        super().observe(name, value, tags, buckets)
         self._emit(name, self._num(value), "h", tags)
 
     def close(self) -> None:

@@ -289,6 +289,10 @@ class QueryProfile:
         "wave",
         "mesh",
         "residency",
+        "admission_wait",
+        "deadline",
+        "retries",
+        "failovers",
         "_last_rpc_bytes",
     )
 
@@ -297,6 +301,22 @@ class QueryProfile:
         self.total_seconds = 0.0
         self.calls: list[dict] = []  # local executor per-call entries
         self.fanout: list[dict] = []  # per-node shard-group entries
+        # seconds this request waited in the event front end's admission
+        # queue before a worker picked it up (None on the threaded
+        # listener, which has no admission lane) — the flight recorder's
+        # "was it the queue or the query" attribution
+        self.admission_wait: float | None = None
+        # per-query deadline accounting at settle: {"budgetS",
+        # "remainingS"} — how much of the promised budget the query
+        # spent (docs/fault-tolerance.md)
+        self.deadline: dict | None = None
+        # retry/failover attribution (docs/fault-tolerance.md): the
+        # resilient RPC chain notes each retry sleep it takes on this
+        # query's behalf, and the fan-out notes each leg it re-planned
+        # onto a surviving replica — tail latency from a flaky peer is
+        # visible in the evidence, not just in global counters
+        self.retries: list[dict] = []
+        self.failovers: list[dict] = []
         # set by the wave scheduler when this query rode a shared wave:
         # {"queries": occupancy, "flushReason": ...} — the ?profile=true
         # surface for cross-query coalescing
@@ -351,6 +371,18 @@ class QueryProfile:
             }
         )
 
+    def note_retry(self, method: str, node: str, attempt: int) -> None:
+        """The resilient client reports each retry attempt it makes for
+        an RPC issued under this query (docs/fault-tolerance.md)."""
+        self.retries.append({"method": method, "node": node, "attempt": attempt})
+
+    def note_failover(self, node: str, to_node: str, shards: list[int] | None) -> None:
+        """The cluster fan-out reports each leg it re-planned from a
+        failed peer onto a surviving replica."""
+        self.failovers.append(
+            {"node": node, "toNode": to_node, "shards": shards}
+        )
+
     def note_rpc_bytes(self, n: int) -> None:
         """The internal client reports each response's size here; the
         fan-out reads it back to attribute wire bytes to the shard-group
@@ -382,6 +414,14 @@ class QueryProfile:
             out["mesh"] = self.mesh
         if self.residency is not None:
             out["residency"] = self.residency
+        if self.admission_wait is not None:
+            out["admissionWaitSeconds"] = self.admission_wait
+        if self.deadline is not None:
+            out["deadline"] = self.deadline
+        if self.retries:
+            out["retries"] = self.retries
+        if self.failovers:
+            out["failovers"] = self.failovers
         if self.trace_id:
             out["traceID"] = self.trace_id
         return out

@@ -75,6 +75,12 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "observability: flight recorder / EXPLAIN / router-audit suite "
+        "(tests/test_flightrec.py; runs in tier-1 — the marker exists so "
+        "`pytest -m observability` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -1,0 +1,14 @@
+"""Seeded violations for the metric⇄docs drift check: a metric
+registered in code with no catalog row (dark_metric), while the docs
+carry a row for a metric that no longer exists (ghost_metric)."""
+
+
+class Service:
+    def __init__(self, stats):
+        self.stats = stats
+
+    def serve(self, seconds: float) -> None:
+        self.stats.count("requests_total", tags={"route": "query"})
+        # undocumented: no catalog row anywhere
+        self.stats.count("dark_metric")
+        self.stats.timing("serve", seconds)

@@ -1,0 +1,14 @@
+"""Clean twin for the metric⇄docs drift check: every registered metric
+name has a catalog row in docs/observability.md and vice versa."""
+
+
+class Service:
+    def __init__(self, stats):
+        self.stats = stats
+
+    def serve(self, seconds: float) -> None:
+        self.stats.count("requests_total", tags={"route": "query"})
+        self.stats.gauge("inflight", 1.0)
+        # timer families get the _seconds unit suffix at exposition
+        self.stats.timing("serve", seconds)
+        self.stats.observe("batch_size", 4.0)

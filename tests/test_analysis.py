@@ -517,7 +517,7 @@ def test_asyncpurity_thread_spawn_in_coroutine_fails(tree_copy):
         tree_copy / "pilosa_tpu" / "server" / "eventloop.py",
         "payload, close = await loop.run_in_executor(\n"
         "                self._pool, self._run_request, raw, writer, deadline,\n"
-        "                direct_ok,\n"
+        "                direct_ok, wait_s,\n"
         "            )",
         "_t = threading.Thread(\n"
         "                target=self._run_request, args=(raw, writer, deadline)\n"
@@ -722,3 +722,59 @@ def test_fix_cli_flag(tmp_path):
     rc, _ = run_analyzer(str(target), "--rule", "raw-acquire", "--fix")
     assert rc == 0
     assert target.read_text() == before
+
+
+# ------------------------------------------------- metric⇄docs drift
+def test_obsmetrics_fixture_ok():
+    root = FIXTURES / "obsmetrics_ok"
+    rc, out = run_analyzer(str(root / "pkg"), "--root", str(root))
+    assert rc == 0, out
+
+
+def test_obsmetrics_fixture_bad():
+    root = FIXTURES / "obsmetrics_bad"
+    rc, out = run_analyzer(str(root / "pkg"), "--root", str(root))
+    assert rc != 0
+    # undocumented registration AND stale catalog row both fire
+    assert "[observability]" in out
+    assert "dark_metric" in out
+    assert "ghost_metric" in out
+
+
+def test_metric_drift_dropped_doc_row_fails(tree_copy):
+    # drop one catalog row from the live docs: the registered metric
+    # behind it goes undocumented and the tree must go red
+    mutate(
+        tree_copy / "docs" / "observability.md",
+        "| `pilosa_tpu_queries_routed` |",
+        "| `retired_queries_routed` |",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "queries_routed" in out
+
+
+def test_metric_drift_undocumented_registration_fails(tree_copy):
+    # register a brand-new metric with no catalog row
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "http.py",
+        'self.stats.count("http_requests", tags={"route": name})',
+        'self.stats.count("http_requests", tags={"route": name})\n'
+        '                    self.stats.count("covert_channel_total")',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "covert_channel_total" in out
+
+
+def test_metric_drift_stale_doc_row_fails(tree_copy):
+    # a catalog row whose metric no longer exists anywhere in code
+    mutate(
+        tree_copy / "docs" / "observability.md",
+        "| `pilosa_tpu_queries_gated` | counter | — |",
+        "| `pilosa_tpu_queries_gated` | counter | — |\n"
+        "| `pilosa_tpu_vanished_metric` | counter | — | gone |",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[observability]" in out and "vanished_metric" in out

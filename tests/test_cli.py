@@ -115,3 +115,32 @@ def test_apply_jax_platform_env_never_widens(monkeypatch):
         assert jax.config.jax_platforms == "cpu"
     finally:
         jax.config.update("jax_platforms", "cpu")  # leave the suite pinned
+
+
+def test_cli_explain_and_analyze(srv, tmp_path, capsys):
+    csv = tmp_path / "ex.csv"
+    csv.write_text("1,10\n1,20\n")
+    host = f"127.0.0.1:{srv.port}"
+    assert cli.main(["import", str(csv), "--host", host, "-i", "e",
+                     "-f", "f", "--create"]) == 0
+    capsys.readouterr()
+    # plan only: the cost table renders with the chosen path marked
+    assert cli.main(["explain", "Count(Row(f=1))", "--host", host,
+                     "-i", "e"]) == 0
+    out = capsys.readouterr().out
+    assert "route mode:" in out and "host" in out and "device" in out
+    assert "* " in out  # chosen-candidate marker
+    assert "results:" not in out  # nothing executed
+    # analyze: measured actuals + results
+    assert cli.main(["explain", "Count(Row(f=1))", "--host", host,
+                     "-i", "e", "--analyze"]) == 0
+    out = capsys.readouterr().out
+    assert "measured" in out and "error x" in out
+    assert "results: [2]" in out
+    # raw JSON passthrough
+    assert cli.main(["explain", "Count(Row(f=1))", "--host", host,
+                     "-i", "e", "--json"]) == 0
+    import json as _json
+
+    payload = _json.loads(capsys.readouterr().out)
+    assert payload["explain"]["calls"][0]["call"] == "Count"

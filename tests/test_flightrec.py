@@ -1,0 +1,639 @@
+"""Flight recorder + EXPLAIN/ANALYZE + router-decision audit suite
+(docs/observability.md).
+
+Covers the three-part self-diagnosis layer end to end:
+
+- tail-based retention (rolling per-call-type p95, errors always
+  retained, bounded ring, lazy evidence thunk);
+- the HTTP surface: GET /debug/flightrec (+ per-trace entry + Perfetto
+  export), ?explain=true (plan only — nothing executes) and
+  ?explain=analyze (estimates with measured actuals);
+- the settle-time router audit: a seeded-bogus-EWMA misroute increments
+  ``router_misroute_total`` and shows drift in ``routerAudit``;
+- trace propagation through the admission lane and into a compaction
+  triggered by the originating write;
+- the uniform /debug/vars snapshot envelope.
+
+The 2-node fault-injected e2e (a deliberately delayed query retained
+WITHOUT ?profile=true, exportable to Perfetto by trace id) lives here
+too — the acceptance scenario.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.config import Config
+from pilosa_tpu.utils.flightrec import _MIN_SAMPLES, FlightRecorder
+from pilosa_tpu.utils.stats import StatsClient
+from pilosa_tpu.utils.tracing import GLOBAL_TRACER
+
+pytestmark = pytest.mark.observability
+
+
+def free_ports(k):
+    import socket
+
+    socks = [socket.socket() for _ in range(k)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def call(port, body, path="/index/i/query", method="POST"):
+    data = (
+        body
+        if isinstance(body, (bytes, type(None)))
+        else json.dumps(body).encode()
+    )
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        return json.loads(resp.read())
+
+
+# ------------------------------------------------------ recorder unit
+class TestFlightRecorder:
+    def test_error_always_retained(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        ok = rec.settle(
+            "Count", 0.001, lambda: {"traceId": "t1"},
+            error=ValueError("boom"),
+        )
+        assert ok
+        (e,) = rec.entries()
+        assert e["reason"] == "error"
+        assert "ValueError" in e["error"]
+        assert e["callType"] == "Count"
+        assert rec.entry("t1") is e
+
+    def test_no_retention_below_min_samples(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        # even a huge outlier is not retained while the window is too
+        # thin to trust a p95
+        assert not rec.settle("Count", 10.0, lambda: {})
+        assert rec.threshold("Count") is None
+
+    def test_slow_retained_after_window_warm(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        for _ in range(_MIN_SAMPLES):
+            assert not rec.settle("Count", 0.001, lambda: {"traceId": "x"})
+        thr = rec.threshold("Count")
+        assert thr is not None and thr < 0.01
+        assert rec.settle("Count", 0.5, lambda: {"traceId": "slow"})
+        (e,) = rec.entries()
+        assert e["reason"] == "slow"
+        assert e["seconds"] == 0.5
+        assert e["thresholdSeconds"] == pytest.approx(thr, rel=0.5)
+        # a fast query stays unretained
+        assert not rec.settle("Count", 0.001, lambda: {})
+
+    def test_min_latency_floor(self):
+        rec = FlightRecorder(min_latency_s=10.0)
+        for _ in range(_MIN_SAMPLES + 5):
+            rec.settle("Count", 0.001, lambda: {})
+        # over the p95 but under the floor: not retained
+        assert not rec.settle("Count", 0.5, lambda: {})
+
+    def test_thresholds_are_per_call_type(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        for _ in range(_MIN_SAMPLES):
+            rec.settle("Count", 0.001, lambda: {})
+        # GroupBy window is empty — its queries never compare against
+        # Count's threshold
+        assert not rec.settle("GroupBy", 0.5, lambda: {})
+        assert rec.settle("Count", 0.5, lambda: {})
+
+    def test_ring_bounded_and_seq_monotone(self):
+        rec = FlightRecorder(capacity=4, min_latency_s=0.0)
+        for i in range(10):
+            rec.settle("Q", 0.0, lambda i=i: {"i": i}, error=RuntimeError(i))
+        entries = rec.entries()
+        assert len(entries) == 4
+        assert [e["i"] for e in entries] == [6, 7, 8, 9]
+        seqs = [e["seq"] for e in entries]
+        assert seqs == sorted(seqs)
+
+    def test_evidence_thunk_lazy(self):
+        calls = []
+        rec = FlightRecorder(min_latency_s=0.0)
+        rec.settle("Count", 0.001, lambda: calls.append(1) or {})
+        assert calls == []  # not retained → never built
+        rec.settle("Count", 0.001, lambda: calls.append(1) or {},
+                   error=ValueError())
+        assert calls == [1]
+
+    def test_disabled_recorder_is_inert(self):
+        rec = FlightRecorder(enabled=False)
+        assert not rec.settle("Count", 99.0, lambda: {}, error=ValueError())
+        assert rec.entries() == []
+
+    def test_window_rotates(self):
+        from pilosa_tpu.utils.flightrec import _WINDOW, _RollingP95
+
+        q = _RollingP95()
+        for _ in range(_WINDOW):
+            q.observe(0.001)
+        assert q.prev is not None and q.cur.count == 0
+        q.observe(0.002)
+        assert q.samples() == _WINDOW + 1
+        assert q.percentile(0.95) > 0
+
+    def test_retention_counter_and_structured_log(self):
+        stats = StatsClient()
+        lines = []
+        rec = FlightRecorder(min_latency_s=0.0, stats=stats, log=lines.append)
+        rec.settle(
+            "Count", 0.2,
+            lambda: {"traceId": "abcd", "index": "i", "query": "Count(...)"},
+            error=ValueError("x"),
+        )
+        c = stats.expvar()["counters"]
+        assert c["flightrec_retained_total{reason=error}"] == 1
+        (line,) = lines
+        assert line.startswith("flightrec ")
+        payload = json.loads(line.split(" ", 1)[1])
+        assert payload["traceId"] == "abcd"
+        assert payload["reason"] == "error"
+
+    def test_snapshot_shape(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        for _ in range(_MIN_SAMPLES):
+            rec.settle("Count", 0.001, lambda: {})
+        rec.settle("Count", 1.0, lambda: {"traceId": "t", "query": "Count()"})
+        snap = rec.snapshot()
+        assert snap["enabled"] and snap["capacity"] == 256
+        assert snap["retained"]["slow"] == 1
+        assert snap["thresholds"]["Count"]["samples"] >= _MIN_SAMPLES
+        assert snap["thresholds"]["Count"]["p95Seconds"] is not None
+        (s,) = snap["entries"]
+        # summaries never carry the heavy evidence
+        assert "profile" not in s and "spans" not in s
+        assert s["traceId"] == "t"
+
+    def test_perfetto_from_retained_spans(self):
+        rec = FlightRecorder(min_latency_s=0.0)
+        with GLOBAL_TRACER.span("q.root") as sp:
+            with GLOBAL_TRACER.span("q.child"):
+                pass
+        spans = GLOBAL_TRACER.spans_for_trace(sp.trace_id)
+        rec.settle(
+            "Count", 0.0,
+            lambda: {"traceId": sp.trace_id, "spans": spans},
+            error=ValueError(),
+        )
+        out = rec.perfetto(sp.trace_id, node_id="n0")
+        names = {e["name"] for e in out["traceEvents"]}
+        assert "q.root" in names and "q.child" in names
+        assert rec.perfetto("missing") is None
+
+
+# ------------------------------------------------------- router audit
+class TestRouterAudit:
+    def test_calibrated_decision_no_misroute(self):
+        from pilosa_tpu.executor.router import RouterAudit
+
+        stats = StatsClient()
+        a = RouterAudit(stats=stats)
+        a.record("host", {"host": 1e-3, "device": 5e-3}, 1.1e-3)
+        snap = a.snapshot()
+        assert snap["misrouteTotal"] == 0
+        assert snap["perPath"]["host"]["samples"] == 1
+        assert snap["perPath"]["host"]["errorRatioEwma"] == pytest.approx(
+            1.1, rel=0.01
+        )
+        dist = stats.distribution(
+            "router_estimate_error_ratio", {"path": "host"}
+        )
+        assert dist is not None and dist.count == 1
+
+    def test_misroute_counts_past_margin(self):
+        from pilosa_tpu.executor.router import RouterAudit
+
+        stats = StatsClient()
+        a = RouterAudit(stats=stats)
+        # chosen host measured 20ms; device estimated 3ms → >2x margin
+        a.record("host", {"host": 1e-4, "device": 3e-3}, 0.020)
+        snap = a.snapshot()
+        assert snap["misrouteTotal"] == 1
+        assert snap["misroutes"] == [
+            {"chosen": "host", "better": "device", "count": 1}
+        ]
+        c = stats.expvar()["counters"]
+        assert c["router_misroute_total{better=device,chosen=host}"] == 1
+
+    def test_within_margin_not_a_misroute(self):
+        from pilosa_tpu.executor.router import RouterAudit
+
+        a = RouterAudit()
+        # measured exceeds the alternative, but within the 2x margin
+        a.record("host", {"host": 1e-3, "device": 3e-3}, 0.005)
+        assert a.snapshot()["misrouteTotal"] == 0
+
+    def test_disabled_audit_records_nothing(self):
+        from pilosa_tpu.executor.router import RouterAudit
+
+        a = RouterAudit(enabled=False)
+        a.record("host", {"host": 1e-4, "device": 3e-3}, 0.5)
+        assert a.snapshot()["perPath"] == {}
+
+    def test_seeded_bogus_ewma_forces_misroute_counter(self):
+        """The acceptance shape: a router whose seeds make the device
+        path look free routes a host-cheap query to the device; the
+        settle-time audit scores measured reality against the host
+        estimate and increments router_misroute_total."""
+        import numpy as np
+
+        from pilosa_tpu.core import Holder
+        from pilosa_tpu.executor import Executor
+        from pilosa_tpu.executor.router import QueryRouter
+
+        stats = StatsClient()
+        h = Holder(None)
+        idx = h.create_index("mis")
+        f = idx.create_field("f")
+        f.import_bulk(
+            np.ones(64, dtype=np.uint64),
+            np.arange(64, dtype=np.uint64),
+        )
+        router = QueryRouter(
+            mode="auto",
+            stats=stats,
+            # bogus calibration: device dispatch+readback "free", so
+            # the router sends even tiny queries to the device
+            dispatch_seed_s=1e-9,
+            readback_seed_s=1e-9,
+            device_wps=1e18,
+        )
+        ex = Executor(h, stats=stats, router=router)
+        for _ in range(3):
+            ex.execute("mis", "Count(Row(f=1))")
+        c = stats.expvar()["counters"]
+        assert c.get("router_misroute_total{better=host,chosen=device}", 0) >= 1
+        drift = router.audit.snapshot()
+        assert drift["misrouteTotal"] >= 1
+        # the drift signal: measured device cost far above its estimate
+        assert drift["perPath"]["device"]["errorRatioEwma"] > 2.0
+
+
+# ----------------------------------------------------- HTTP single node
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    port = free_ports(1)[0]
+    cfg = Config(
+        bind=f"127.0.0.1:{port}",
+        data_dir=str(tmp_path_factory.mktemp("flightrec-data")),
+        anti_entropy_interval=0,
+        diagnostics_interval=0,
+        flightrec_min_ms=0.0,
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(120)
+    call(port, {}, path="/index/i")
+    call(port, {}, path="/index/i/field/f")
+    call(
+        port,
+        {"rowIDs": [1, 1, 2], "columnIDs": [1, 2, 3]},
+        path="/index/i/field/f/import",
+    )
+    yield s, port
+    s.close()
+
+
+class TestHTTPSurface:
+    def test_errored_query_retained_and_exportable(self, server):
+        s, port = server
+        with pytest.raises(urllib.error.HTTPError):
+            call(port, b"Count(Row(ghost=1))")
+        fr = get(port, "/debug/flightrec")
+        errs = [e for e in fr["entries"] if e["reason"] == "error"]
+        assert errs, fr
+        tid = errs[0]["traceId"]
+        full = get(port, f"/debug/flightrec?trace_id={tid}")
+        assert full["error"].startswith("ExecutionError")
+        assert full["profile"]["traceID"] == tid
+        perf = get(port, f"/debug/flightrec?trace_id={tid}&format=perfetto")
+        assert any(
+            e["name"] == "pql.query" for e in perf["traceEvents"]
+        )
+
+    def test_flightrec_unknown_trace_404(self, server):
+        _s, port = server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            get(port, "/debug/flightrec?trace_id=deadbeef")
+        assert ei.value.code == 404
+
+    def test_explain_plan_only_does_not_execute(self, server):
+        s, port = server
+        before = s.stats.expvar()["counters"]
+        routed_before = sum(
+            v for k, v in before.items() if k.startswith("queries_routed")
+        )
+        out = call(port, b"Count(Row(f=1))", path="/index/i/query?explain=true")
+        assert "results" not in out
+        plan = out["explain"]
+        assert plan["routeMode"] == "auto"
+        (c,) = plan["calls"]
+        assert c["call"] == "Count"
+        assert {"host", "device"} <= set(c["candidates"])
+        chosen = [p for p, v in c["candidates"].items() if v["chosen"]]
+        assert chosen == [c["route"]]
+        assert c["estimatedWorkWords"] > 0
+        assert "residency" in c and "mesh" in c
+        assert plan["waveScheduler"]["mode"] in ("adaptive", "always", "off")
+        after = s.stats.expvar()["counters"]
+        routed_after = sum(
+            v for k, v in after.items() if k.startswith("queries_routed")
+        )
+        assert routed_after == routed_before  # nothing executed
+
+    def test_explain_analyze_attaches_actuals(self, server):
+        _s, port = server
+        out = call(
+            port, b"Count(Row(f=1))", path="/index/i/query?explain=analyze"
+        )
+        assert out["results"] == [2]
+        plan = out["explain"]
+        (c,) = plan["calls"]
+        assert c["actualSeconds"] > 0
+        assert c["actualRoute"] in ("host", "device", "mesh")
+        chosen = c["candidates"][c["actualRoute"]]
+        assert chosen["measuredSeconds"] > 0
+        assert chosen["errorRatio"] == pytest.approx(
+            chosen["measuredSeconds"] / chosen["estimatedSeconds"]
+        )
+        assert plan["actualTotalSeconds"] > 0
+
+    def test_explain_write_call(self, server):
+        _s, port = server
+        out = call(port, b"Set(9, f=9)", path="/index/i/query?explain=true")
+        (c,) = out["explain"]["calls"]
+        assert c == {"call": "Set", "route": "write"}
+        # plan-only: the write must NOT have landed
+        res = call(port, b"Count(Row(f=9))")
+        assert res["results"] == [0]
+
+    def test_profile_still_works_and_carries_admission_wait(self, server):
+        _s, port = server
+        out = call(port, b"Count(Row(f=1))", path="/index/i/query?profile=true")
+        prof = out["profile"]
+        assert prof["calls"][0]["call"] == "Count"
+        # event front end: the admission-lane wait is attributed per
+        # request (>= 0 even uncontended)
+        assert "admissionWaitSeconds" in prof
+        assert prof["admissionWaitSeconds"] >= 0.0
+
+    def test_debug_vars_envelope_schema(self, server):
+        _s, port = server
+        dv = get(port, "/debug/vars")
+        for section in (
+            "queryRouting",
+            "routerAudit",
+            "queryBatching",
+            "serving",
+            "durability",
+            "deviceResidency",
+            "meshExecution",
+            "stackCache",
+        ):
+            sec = dv[section]
+            assert isinstance(sec, dict), section
+            assert isinstance(sec["snapshotMonotonicS"], float), section
+            assert isinstance(sec["generatedAt"], str), section
+            # ISO-8601 UTC wall stamp
+            assert sec["generatedAt"].startswith("20"), section
+        audit = dv["routerAudit"]
+        assert "perPath" in audit and "misroutes" in audit
+
+    def test_metrics_exposition_round_trip(self, server):
+        """Scrape /metrics and parse it with the exposition-format
+        grammar: every family has exactly one HELP and one TYPE line
+        (before its samples), buckets are cumulative, and every sample
+        parses."""
+        s, port = server
+        # a label value with every character the escaper must handle
+        s.stats.count("escape_probe", tags={"v": 'a\\b"c\nd'})
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics"
+        ) as resp:
+            assert "version=0.0.4" in resp.headers["Content-Type"]
+            text = resp.read().decode()
+        help_seen, type_seen, samples = {}, {}, {}
+        sample_re = __import__("re").compile(
+            r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(?:[a-zA-Z_][a-zA-Z0-9_]*='
+            r'"(?:[^"\\\n]|\\\\|\\"|\\n)*",?)*\})? (\S+)$'
+        )
+        for line in text.splitlines():
+            if not line:
+                continue
+            if line.startswith("# HELP "):
+                fam = line.split(" ", 3)[2]
+                assert fam not in help_seen, f"duplicate HELP {fam}"
+                help_seen[fam] = True
+            elif line.startswith("# TYPE "):
+                _, _, fam, kind = line.split(" ", 3)
+                assert fam not in type_seen, f"duplicate TYPE {fam}"
+                assert kind in ("counter", "gauge", "histogram")
+                type_seen[fam] = kind
+            else:
+                m = sample_re.match(line)
+                assert m, f"unparseable sample line: {line!r}"
+                float(m.group(3))  # value must parse as a number
+                samples.setdefault(m.group(1), []).append(line)
+        assert set(help_seen) == set(type_seen)
+        # every sample belongs to a declared family (histogram samples
+        # use the family's _bucket/_sum/_count suffixes)
+        for name in samples:
+            base = name
+            for suffix in ("_bucket", "_sum", "_count"):
+                if name.endswith(suffix) and name[: -len(suffix)] in type_seen:
+                    base = name[: -len(suffix)]
+            assert base in type_seen, f"sample {name} has no TYPE"
+        # the escaped label round-trips
+        probe = [
+            line
+            for lines in samples.values()
+            for line in lines
+            if line.startswith("pilosa_tpu_escape_probe")
+        ]
+        assert probe and '\\"c' in probe[0] and "\\n" in probe[0]
+        # histogram buckets are cumulative (monotone nondecreasing)
+        qs = [
+            line
+            for line in samples.get("pilosa_tpu_query_seconds_bucket", [])
+            if 'index="i"' in line
+        ]
+        counts = [float(line.rsplit(" ", 1)[1]) for line in qs]
+        assert counts and counts == sorted(counts)
+
+
+# ------------------------------------------- trace propagation satellite
+class TestTracePropagation:
+    def test_admission_lane_query_joins_originating_trace(self, server):
+        """A query that waits in the event front end's admission lane
+        still appears under the trace id the CLIENT chose — queue time
+        must not orphan the trace — and its admission wait is
+        attributed in the profile."""
+        _s, port = server
+        tid = "ab" * 16
+        results = []
+
+        def one(i, trace=None):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/index/i/query?profile=true",
+                data=b"Count(Row(f=1))",
+                method="POST",
+            )
+            if trace:
+                req.add_header("X-Pilosa-Trace-Id", trace)
+            with urllib.request.urlopen(req) as resp:
+                results.append((i, json.loads(resp.read())))
+
+        # concurrent burst so admission ordering is exercised; one of
+        # them carries the caller's trace id
+        ts = [
+            threading.Thread(target=one, args=(i, tid if i == 0 else None))
+            for i in range(6)
+        ]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        traced = dict(results)[0]
+        assert traced["profile"]["admissionWaitSeconds"] >= 0.0
+        spans = GLOBAL_TRACER.spans_for_trace(tid)
+        names = {s["name"] for s in spans}
+        # the request's handler span AND the query span both joined the
+        # propagated trace
+        assert "http.query" in names and "pql.query" in names
+
+    def test_compaction_joins_originating_trace(self, tmp_path):
+        """A write whose ops log trips the compaction threshold queues a
+        background fold — whose compaction.run span must join the
+        ORIGINATING write's trace, not start a disconnected one."""
+        from pilosa_tpu.core import Holder
+
+        h = Holder(str(tmp_path / "data"))
+        h.open()
+        idx = h.create_index("c")
+        f = idx.create_field("f")
+        frag = f.create_view_if_not_exists(
+            "standard"
+        ).create_fragment_if_not_exists(0)
+        frag.max_op_n = 4
+        with GLOBAL_TRACER.span("test.write") as sp:
+            for col in range(12):
+                f.set_bit(1, col)
+        assert h.compactor.wait_idle(20.0)
+        spans = GLOBAL_TRACER.spans_for_trace(sp.trace_id)
+        comp = [s for s in spans if s["name"] == "compaction.run"]
+        assert comp, "compaction.run did not join the originating trace"
+        assert comp[0]["traceID"] == sp.trace_id
+        h.close()
+
+
+# ------------------------------------------------- 2-node acceptance e2e
+def _make_cluster(tmp_path, n=2, **extra):
+    ports = free_ports(n)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(n):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            replica_n=1,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+            heartbeat_interval=60.0,
+            **extra,
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    for s in servers:
+        s.cluster._heartbeat_once()
+    return servers, ports
+
+
+def test_slow_query_retained_e2e_without_profile_flag(tmp_path):
+    """THE acceptance scenario: a deliberately slow query (fault-
+    injected RPC delay) is retained in /debug/flightrec with route and
+    fan-out attribution and is exportable to Perfetto by trace id —
+    with ?profile never set on the request."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    servers, ports = _make_cluster(tmp_path, flightrec_min_ms=0.0)
+    try:
+        call(ports[0], {}, path="/index/i")
+        call(ports[0], {}, path="/index/i/field/f")
+        cols = [s * SHARD_WIDTH + 1 for s in range(8)]
+        call(
+            ports[0],
+            {"rowIDs": [1] * len(cols), "columnIDs": cols},
+            path="/index/i/field/f/import",
+        )
+        # warm the Count window past the minimum sample floor — plain
+        # queries, no profile flag anywhere
+        for _ in range(_MIN_SAMPLES + 2):
+            call(ports[0], b"Count(Row(f=1))")
+        # deliberate slowness: every outgoing fan-out RPC leg from the
+        # coordinator pays a 250ms injected delay
+        servers[0].fault_injector.set_rules(
+            [
+                {
+                    "path": "/internal/query",
+                    "action": "delay",
+                    "delay_ms": 250.0,
+                }
+            ],
+            seed=7,
+        )
+        call(ports[0], b"Count(Row(f=1))")  # the slow one; no ?profile
+        servers[0].fault_injector.clear()
+        fr = get(ports[0], "/debug/flightrec")
+        slow = [
+            e
+            for e in fr["entries"]
+            if e["reason"] == "slow" and e["seconds"] >= 0.2
+        ]
+        assert slow, fr["entries"]
+        tid = slow[0]["traceId"]
+        full = get(ports[0], f"/debug/flightrec?trace_id={tid}")
+        prof = full["profile"]
+        # route attribution on the local leg's calls
+        assert all("route" in c or c["call"] == "_readback"
+                   for c in prof["calls"])
+        # fan-out attribution names the delayed peer leg
+        assert prof["fanout"], prof
+        assert max(leg["seconds"] for leg in prof["fanout"]) >= 0.2
+        # admission attribution (event front end)
+        assert "admissionWaitSeconds" in prof
+        # Perfetto export by trace id, from the RETAINED spans
+        perf = get(
+            ports[0], f"/debug/flightrec?trace_id={tid}&format=perfetto"
+        )
+        names = {e["name"] for e in perf["traceEvents"]}
+        assert "pql.query" in names
+        # the structured slow-query log line fired with the trace id
+        assert (
+            get(ports[0], "/debug/flightrec")["retained"]["slow"] >= 1
+        )
+    finally:
+        for s in servers:
+            s.close()

@@ -161,3 +161,52 @@ def test_nop_stats_timing_noop():
     c.timing("query_seconds", 1.0)
     assert c.histogram("query_seconds") is None
     assert c.prometheus() == "\n"
+
+
+# ------------------------------------------- exposition conformance
+def test_help_and_type_once_per_family():
+    c = StatsClient()
+    c.count("http_requests", tags={"route": "a"})
+    c.count("http_requests", tags={"route": "b"})
+    c.timing("query_seconds", 0.01, tags={"index": "x"})
+    c.timing("query_seconds", 0.02, tags={"index": "y"})
+    text = c.prometheus()
+    # one HELP + one TYPE per FAMILY (not per labeled series), and the
+    # header precedes the family's first sample
+    assert text.count("# HELP pilosa_tpu_http_requests ") == 1
+    assert text.count("# TYPE pilosa_tpu_http_requests counter") == 1
+    assert text.count("# TYPE pilosa_tpu_query_seconds histogram") == 1
+    lines = text.splitlines()
+    first_sample = next(
+        i for i, ln in enumerate(lines)
+        if ln.startswith("pilosa_tpu_http_requests")
+    )
+    type_line = next(
+        i for i, ln in enumerate(lines)
+        if ln == "# TYPE pilosa_tpu_http_requests counter"
+    )
+    assert type_line < first_sample
+
+
+def test_label_value_escaping():
+    c = StatsClient()
+    c.count("weird", tags={"v": 'say "hi"\\there\nnow'})
+    text = c.prometheus()
+    (sample,) = [
+        ln for ln in text.splitlines() if ln.startswith("pilosa_tpu_weird{")
+    ]
+    # exposition-format escapes: backslash, double quote, newline
+    assert '\\"hi\\"' in sample
+    assert "\\\\there" in sample
+    assert "\\nnow" in sample
+    assert "\n" not in sample[:-1]
+
+
+def test_observe_custom_buckets():
+    c = StatsClient()
+    c.observe("ratio_dist", 0.5, buckets=DEFAULT_BUCKETS)
+    h = c.distribution("ratio_dist")
+    assert h is not None and h.buckets == DEFAULT_BUCKETS
+    # sub-1.0 values resolve instead of collapsing into the first
+    # power-of-two count bucket
+    assert 0.1 < h.percentile(0.5) < 1.0

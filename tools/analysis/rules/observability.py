@@ -23,6 +23,16 @@ always be attributable.  Enforced structurally:
    (``timer``/``timing``) and count (``queries_served``) the batch —
    wave coalescing must never make remote legs untraceable.
 
+5. **metric⇄docs drift** — every counter/gauge/histogram/distribution
+   name registered anywhere in the package (a string literal handed to
+   a ``*stats.count/gauge/timing/timer/observe`` call) must have a
+   catalog row in ``docs/observability.md`` (spelled
+   ``pilosa_tpu_<name>``, timers with the ``_seconds`` unit suffix the
+   exposition layer appends), and every catalog row must correspond to
+   a registered name — the metric catalog is the operator contract the
+   same way ``docs/configuration.md`` is (the config-drift rule is the
+   template), so an undocumented metric or a stale row fails the gate.
+
 Files are located by project-relative suffix so tests can run the rule
 against a mutated copy of the tree.
 """
@@ -30,11 +40,50 @@ against a mutated copy of the tree.
 from __future__ import annotations
 
 import ast
+import re
 
 from tools.analysis.engine import Project, Violation, call_name, rule
 
 HTTP = "server/http.py"
 CLUSTER = "parallel/cluster.py"
+METRICS_DOC = "docs/observability.md"
+
+_STATS_METHODS = ("count", "gauge", "timing", "timer", "observe")
+# catalog rows: | `pilosa_tpu_<name>` | ...
+_DOC_METRIC_RE = re.compile(r"\|\s*`pilosa_tpu_([a-z0-9_]+)`")
+
+
+def _registered_metrics(project: Project) -> dict[str, tuple[str, int]]:
+    """Metric family names registered in code → (file, line) of one
+    registration site.  A registration is a call ``<recv>.<method>(
+    "<name>", ...)`` where ``recv`` is a stats client (its dotted name
+    ends in ``stats``/``_stats``) and ``<method>`` is one of the
+    StatsClient emitters; timer/timing families get the ``_seconds``
+    unit suffix the exposition layer appends."""
+    out: dict[str, tuple[str, int]] = {}
+    for f in project.files:
+        if f.tree is None or not f.rel.endswith(".py"):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node.func)
+            parts = name.rsplit(".", 2)
+            if len(parts) < 2 or parts[-1] not in _STATS_METHODS:
+                continue
+            recv = parts[-2]
+            if not (recv == "stats" or recv.endswith("_stats")):
+                continue
+            arg = node.args[0]
+            if not (isinstance(arg, ast.Constant) and isinstance(arg.value, str)):
+                continue
+            metric = arg.value
+            if parts[-1] in ("timing", "timer") and not metric.endswith(
+                "_seconds"
+            ):
+                metric += "_seconds"
+            out.setdefault(metric, (f.rel, node.lineno))
+    return out
 
 
 def _calls_in(node: ast.AST) -> set[str]:
@@ -208,4 +257,43 @@ def check_observability(project: Project) -> list[Violation]:
                             "remote legs would serve dark",
                         )
                     )
+
+    # 5. metric-name ⇄ docs drift: the catalog in docs/observability.md
+    # must list every registered metric and nothing else (mirroring the
+    # config-drift rule's contract for docs/configuration.md). Skipped
+    # when the doc is absent (mini fixture trees without docs).
+    doc = project.doc(METRICS_DOC)
+    registered = _registered_metrics(project)
+    # the stale-row direction needs the WHOLE package in view: a
+    # single-file fixture run (which registers nothing) would otherwise
+    # flag every live catalog row as stale
+    if doc is not None and registered:
+        documented: dict[str, int] = {}
+        for m in _DOC_METRIC_RE.finditer(doc):
+            documented.setdefault(
+                m.group(1), doc[: m.start()].count("\n") + 1
+            )
+        for metric, (rel, line) in sorted(registered.items()):
+            if metric not in documented:
+                out.append(
+                    Violation(
+                        "observability",
+                        rel,
+                        line,
+                        f"metric `pilosa_tpu_{metric}` is registered here "
+                        f"but has no catalog row in {METRICS_DOC} — "
+                        "operators cannot discover it",
+                    )
+                )
+        for metric, line in sorted(documented.items()):
+            if metric not in registered:
+                out.append(
+                    Violation(
+                        "observability",
+                        METRICS_DOC,
+                        line,
+                        f"catalog row `pilosa_tpu_{metric}` matches no "
+                        "registered metric — stale docs",
+                    )
+                )
     return out
