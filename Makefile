@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze bench-ingest bench-residency bench-observability
+.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -35,3 +35,10 @@ bench-residency:
 # shape; exits non-zero if the always-on layer costs >3% p50
 bench-observability:
 	set -o pipefail; PILOSA_BENCH_ALL_CHILD=observability python bench_all.py | tee BENCH_OBS_r10.json
+
+# workload-intelligence plane row (docs/workload.md): capture-on vs
+# capture-off c1 p50 on the config8 count shape (exits non-zero past
+# 1.03x) + capture→replay of the config8 mix with per-shape QPS
+# ordering and fidelity-ratio gates
+bench-workload:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=workload python bench_all.py | tee BENCH_WORKLOAD_r11.json

@@ -1199,6 +1199,304 @@ def config_observability():
         sys.exit(1)
 
 
+def config_workload():
+    """ISSUE 11: workload-intelligence plane — capture overhead +
+    capture→replay fidelity (docs/workload.md).  Two event-front-end
+    servers in their own processes: capture-on (the default: fingerprint
+    + sketch + SLO + ring on every settle) vs capture-off
+    (PILOSA_TPU_WORKLOAD_CAPTURE_ENABLED=false).  GATE 1: capture-on c1
+    p50 on the config8 count shape ≤ 1.03x capture-off (interleaved
+    rounds, min per server, back-to-back confirm — the BENCH_OBS_r10
+    methodology), exits non-zero past it.  Then the capture→replay leg:
+    drive the config8 mix (count:topn:groupby at 8:3:1) against the
+    capture-on server, export the ring via /debug/workload?format=
+    capture, and REPLAY it against the same server preserving recorded
+    arrival spacing.  GATE 2: the replayed per-shape QPS ordering must
+    match the recorded ordering, with zero status divergence; the
+    fidelity ratio (1 - total-variation distance between recorded and
+    replayed per-shape shares) is recorded in the artifact
+    (BENCH_WORKLOAD_r11.json)."""
+    import subprocess
+    import sys
+    import tempfile
+    import urllib.request
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils.stats import Histogram
+
+    rng = np.random.default_rng(11)
+    shards = int(os.environ.get("PILOSA_BENCH_SWEEP_SHARDS", "8"))
+    n = shards * SHARD_WIDTH
+    iters = int(os.environ.get("PILOSA_BENCH_WORKLOAD_ITERS", "40"))
+    cols = np.arange(n, dtype=np.uint64)
+    cab_rows = rng.integers(0, 256, n).astype(np.uint64)
+    pc_rows = rng.integers(1, 7, n).astype(np.uint64)
+    # the config8 shapes; count is the overhead probe (cheap + host-
+    # frequent — a fixed per-query settle cost shows up loudest there)
+    queries = {
+        "count": (
+            b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
+            b" Row(cab=4), Row(cab=5), Row(cab=6)))"
+        ),
+        "topn": b"TopN(cab, n=10)",
+        "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+    }
+    # the captured mix: Zipf-ish config8 traffic, 8:3:1. Capture
+    # records carry the PQL call name, so per-shape lookups go through
+    # this map.
+    mix_weights = {"count": 8, "topn": 3, "groupby": 1}
+    call_of = {"count": "Count", "topn": "TopN", "groupby": "GroupBy"}
+    mix_rounds = int(os.environ.get("PILOSA_BENCH_WORKLOAD_MIX_ROUNDS", "20"))
+
+    child_src = (
+        "import sys\n"
+        "from pilosa_tpu.server import Server\n"
+        "from pilosa_tpu.utils.config import load_config\n"
+        "s = Server(load_config())\n"
+        "s.open()\n"
+        "s.wait_mesh(120)\n"
+        "print('READY', flush=True)\n"
+        "sys.stdin.read()\n"
+        "s.close()\n"
+    )
+
+    data_dirs: list = []
+
+    def spawn_server(port: int, capture: bool):
+        data_dirs.append(tempfile.mkdtemp())
+        env = dict(os.environ)
+        env.update({
+            "PILOSA_TPU_BIND": f"127.0.0.1:{port}",
+            "PILOSA_TPU_DATA_DIR": data_dirs[-1],
+            "PILOSA_TPU_ROUTE_MODE": "device",
+            "PILOSA_TPU_MAX_WRITES_PER_REQUEST": "500000",
+            "PILOSA_TPU_ANTI_ENTROPY_INTERVAL": "0",
+            "PILOSA_TPU_DIAGNOSTICS_INTERVAL": "0",
+            "PILOSA_TPU_WORKLOAD_CAPTURE_ENABLED": (
+                "true" if capture else "false"
+            ),
+        })
+        child = subprocess.Popen(
+            [sys.executable, "-c", child_src],
+            env=env,
+            stdin=subprocess.PIPE,
+            stdout=subprocess.PIPE,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        ready = child.stdout.readline().strip()
+        assert ready == "READY", f"workload bench server child failed: {ready!r}"
+        return child
+
+    def stop_server(child) -> None:
+        try:
+            child.stdin.close()
+            child.wait(timeout=30)
+        except Exception:  # noqa: BLE001 — bench teardown best-effort
+            child.kill()
+            child.wait(timeout=10)
+
+    def post(port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            method="POST",
+        )
+        urllib.request.urlopen(req).read()
+
+    def run_query(port, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/index/sw/query",
+            data=body,
+            method="POST",
+        )
+        with urllib.request.urlopen(req) as r:
+            return json.loads(r.read())
+
+    def load_data(port):
+        post(port, "/index/sw", {})
+        post(port, "/index/sw/field/cab", {})
+        post(port, "/index/sw/field/pc", {})
+        for lo in range(0, n, 400_000):
+            post(
+                port,
+                "/index/sw/field/cab/import",
+                {
+                    "rowIDs": cab_rows[lo : lo + 400_000].tolist(),
+                    "columnIDs": cols[lo : lo + 400_000].tolist(),
+                },
+            )
+            post(
+                port,
+                "/index/sw/field/pc/import",
+                {
+                    "rowIDs": pc_rows[lo : lo + 400_000].tolist(),
+                    "columnIDs": cols[lo : lo + 400_000].tolist(),
+                },
+            )
+
+    def measure(port) -> float:
+        """c1 p50 ms over one round of iters warm count queries."""
+        hist = Histogram()
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            run_query(port, queries["count"])
+            hist.observe(time.perf_counter() - t0)
+        return hist.percentile(0.50) * 1e3
+
+    on_port, off_port = free_ports(2)
+    on_srv = spawn_server(on_port, capture=True)
+    off_srv = spawn_server(off_port, capture=False)
+    failed = False
+    try:
+        load_data(on_port)
+        load_data(off_port)
+        for p in (on_port, off_port):
+            for _ in range(5):
+                run_query(p, queries["count"])  # warm programs + caches
+
+        def rounds() -> dict:
+            p50s: dict = {on_port: [], off_port: []}
+            order = [on_port, off_port]
+            for r in range(5):
+                # alternate measurement order: fixed order folds any
+                # drifting neighbor load into one server's minimum
+                for p in order[r % 2 :] + order[: r % 2]:
+                    p50s[p].append(measure(p))
+            return p50s
+
+        p50s = rounds()
+        on_p50, off_p50 = min(p50s[on_port]), min(p50s[off_port])
+        ratio = on_p50 / max(off_p50, 1e-9)
+        if ratio > 1.03:
+            # confirm back-to-back: a genuine fixed per-query cost
+            # reproduces; shared-CPU neighbor noise does not
+            p50s2 = rounds()
+            on_p50 = min(on_p50, *p50s2[on_port])
+            off_p50 = min(off_p50, *p50s2[off_port])
+            ratio = on_p50 / max(off_p50, 1e-9)
+
+        # the capture-off server must actually be off (the ratio must
+        # not pass because both servers were measuring)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{off_port}/debug/vars"
+        ) as r:
+            off_wl = json.loads(r.read()).get("workload", {})
+        line(
+            "workload_capture_overhead_p50_ratio",
+            ratio,
+            "ratio",
+            1.0,
+            extra={
+                "on_p50_ms": round(on_p50, 3),
+                "off_p50_ms": round(off_p50, 3),
+                "offPlaneEnabled": off_wl.get("enabled", True),
+            },
+        )
+        if off_wl.get("enabled", True):
+            failed = True
+            line("workload_capture_off_still_on", 0.0, "error", 0.0)
+        if ratio > 1.03:
+            # the acceptance gate: the always-on capture plane may cost
+            # at most 3% c1 p50 on the cheap count shape
+            failed = True
+            line("workload_overhead_regressed_p50", ratio, "error", ratio)
+
+        # ---- capture→replay fidelity on the capture-on server
+        mix: list = []
+        for _ in range(mix_rounds):
+            batch = [
+                name
+                for name, w in mix_weights.items()
+                for _ in range(w)
+            ]
+            rng.shuffle(batch)
+            mix.extend(batch)
+        for name in mix:
+            run_query(on_port, queries[name])
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{on_port}/debug/workload?format=capture"
+        ) as r:
+            capture_lines = r.read().decode().strip().splitlines()
+        records = [json.loads(ln) for ln in capture_lines][-len(mix):]
+        from pilosa_tpu.utils import workload as wlmod
+
+        recorded = wlmod.recorded_summary(records)
+        replayed = wlmod.replay(
+            records, f"http://127.0.0.1:{on_port}", speed=1.0, workers=4
+        )
+        shapes = sorted(mix_weights)
+        rec_order = sorted(
+            shapes, key=lambda s: -recorded["perCall"][call_of[s]]["qps"]
+        )
+        rep_order = sorted(
+            shapes,
+            key=lambda s: -replayed["perCall"]
+            .get(call_of[s], {})
+            .get("qps", 0.0),
+        )
+        fidelity = 1.0 - 0.5 * sum(
+            abs(
+                recorded["perCall"][call_of[s]]["share"]
+                - replayed["perCall"].get(call_of[s], {}).get("share", 0.0)
+            )
+            for s in shapes
+        )
+        # nonzero cachability: the mix repeats identical queries with
+        # no interleaved writes, so the stamped-result-cache estimate
+        # must see them (the tier-1 test asserts this; recorded here so
+        # the artifact carries the measured sizing input for ROADMAP 2)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{on_port}/debug/workload?top=5"
+        ) as r:
+            wl_report = json.loads(r.read())
+        line(
+            "workload_replay_qps",
+            replayed["qps"],
+            "qps",
+            1.0,
+            extra={
+                "p50_ms": replayed["p50Ms"],
+                "p95_ms": replayed["p95Ms"],
+                "errorRate": replayed["errorRate"],
+                "divergence": replayed["divergence"],
+                "recordedOrdering": rec_order,
+                "replayedOrdering": rep_order,
+                "fidelityRatio": round(fidelity, 4),
+                "recordedPerCall": recorded["perCall"],
+                "replayedPerCall": replayed["perCall"],
+                "cachability": wl_report.get("cachability", {}),
+            },
+        )
+        if rep_order != rec_order:
+            failed = True
+            line(
+                "workload_replay_ordering_diverged", 0.0, "error", 0.0,
+                extra={"recorded": rec_order, "replayed": rep_order},
+            )
+        if replayed["divergence"] != 0 or replayed["completed"] != len(mix):
+            failed = True
+            line(
+                "workload_replay_diverged",
+                float(replayed["divergence"]),
+                "error",
+                0.0,
+                extra={"completed": replayed["completed"], "sent": len(mix)},
+            )
+        if not wl_report.get("cachability", {}).get("servableRepeats", 0):
+            failed = True
+            line("workload_cachability_zero", 0.0, "error", 0.0)
+    finally:
+        stop_server(on_srv)
+        stop_server(off_srv)
+        import shutil
+
+        for d in data_dirs:
+            shutil.rmtree(d, ignore_errors=True)
+    if failed:
+        sys.exit(1)
+
+
 def config_ingest():
     """ISSUE 8: durable ingest under fire (docs/durability.md) — THE
     mixed-workload row.  An event-front-end server in its own process
@@ -2125,6 +2423,7 @@ CONFIGS = {
     "multichip": config_multichip,
     "residency": config_residency,
     "observability": config_observability,
+    "workload": config_workload,
 }
 
 

@@ -244,6 +244,70 @@ def cmd_explain(args) -> int:
     return 0
 
 
+def cmd_replay(args) -> int:
+    """Replay a captured workload against a live server
+    (docs/workload.md).  ``capture`` is a JSONL file, a directory of
+    spill segments (``workload-capture-path``), or ``-`` for stdin
+    (pipe ``curl .../debug/workload?format=capture`` straight in).
+    Default pacing preserves the recorded arrival spacing; ``--speed N``
+    scales it, ``--qps N`` replays at a fixed rate, ``--closed-loop C``
+    discards spacing and drives C back-to-back clients.  The report is
+    bench-row-shaped JSON: QPS, p50/p95, error rate, and the divergence
+    count vs the recorded statuses."""
+    import json as _json
+    import tempfile
+
+    from pilosa_tpu.utils import workload
+
+    _apply_skip_verify(args)
+    path = args.capture
+    tmp_path = None
+    if path == "-":
+        with tempfile.NamedTemporaryFile(
+            "w", suffix=".jsonl", delete=False
+        ) as tmp:
+            tmp.write(sys.stdin.read())
+            path = tmp_path = tmp.name
+    try:
+        records = workload.load_capture(path)
+    finally:
+        if tmp_path is not None:
+            os.unlink(tmp_path)
+    recorded = workload.recorded_summary(records)
+    report = workload.replay(
+        records,
+        _base_uri(args.host),
+        speed=args.speed,
+        qps=args.qps,
+        closed_loop=args.closed_loop,
+        workers=args.workers,
+        timeout=args.timeout,
+        ssl_context=_SSL_CTX,  # --tls-skip-verify
+    )
+    out = {"recorded": recorded, "replay": report}
+    if args.json:
+        print(_json.dumps(out, indent=2))
+        # same contract as the text path (docs/workload.md): divergence
+        # is the exit code signal either way
+        return 0 if report["divergence"] == 0 else 1
+    print(
+        f"replayed {report['completed']}/{report['records']} records in "
+        f"{report['elapsedSeconds']:.2f}s ({report['mode']}): "
+        f"{report['qps']:.1f} qps  p50 {report['p50Ms']:.2f}ms  "
+        f"p95 {report['p95Ms']:.2f}ms  errors {report['errorRate']:.4f}  "
+        f"divergence {report['divergence']}"
+    )
+    for call, c in report["perCall"].items():
+        rec = recorded["perCall"].get(call, {})
+        print(
+            f"  {call:<10} sent={c['sent']:<6} share={c['share']:<7}"
+            f" qps={c['qps']:<9} p50={c['p50Ms']}ms"
+            f" (recorded share={rec.get('share')}, qps={rec.get('qps')})"
+            + (f"  DIVERGED={c['divergence']}" if c["divergence"] else "")
+        )
+    return 0 if report["divergence"] == 0 else 1
+
+
 def cmd_config(args) -> int:
     from pilosa_tpu.utils.config import config_template, dump_config, load_config
 
@@ -361,6 +425,31 @@ def main(argv: list[str] | None = None) -> int:
                    help="execute too and attach measured actuals")
     s.add_argument("--json", action="store_true", help="raw JSON output")
     s.set_defaults(fn=cmd_explain)
+
+    s = sub.add_parser(
+        "replay", help="replay a captured workload against a live server"
+    )
+    s.add_argument(
+        "capture",
+        help="JSONL capture file, spill-segment directory, or - for stdin",
+    )
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port or https://host:port for TLS servers")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
+    s.add_argument("--speed", type=float, default=1.0,
+                   help="scale recorded arrival spacing by N (default 1.0)")
+    s.add_argument("--qps", type=float, default=None,
+                   help="replay at a fixed rate instead of recorded spacing")
+    s.add_argument("--closed-loop", type=int, default=None, metavar="C",
+                   help="C back-to-back clients (throughput mode; "
+                        "discards spacing)")
+    s.add_argument("--workers", type=int, default=8,
+                   help="open-loop worker connections (default 8)")
+    s.add_argument("--timeout", type=float, default=30.0,
+                   help="per-request timeout seconds")
+    s.add_argument("--json", action="store_true", help="raw JSON report")
+    s.set_defaults(fn=cmd_replay)
 
     s = sub.add_parser("config", help="print effective config")
     s.add_argument("--config", default=None)

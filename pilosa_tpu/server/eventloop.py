@@ -130,7 +130,8 @@ class _BufferedHandler(Handler):
     back; ``close_connection`` reports the keep-alive decision."""
 
     def __init__(self, server, raw: bytes, client_address, deadline=None,
-                 admission_wait: float | None = None):
+                 admission_wait: float | None = None,
+                 arrival: float | None = None):
         # deliberately NOT calling super().__init__: the socketserver
         # constructor runs the blocking per-connection protocol; this
         # shim replaces exactly that part
@@ -145,6 +146,11 @@ class _BufferedHandler(Handler):
         # and the flight recorder attribute queue time vs query time
         # from it (docs/observability.md)
         self.admission_wait_s = admission_wait
+        # monotonic instant the request HEAD started arriving: the
+        # workload capture stamps records with it so replayed arrival
+        # spacing reflects offered load, not settle times
+        # (docs/workload.md; None on the threaded listener)
+        self.arrival_monotonic = arrival
         self.close_connection = True
         self.requestline = ""
         self.request_version = ""
@@ -446,6 +452,11 @@ class EventHTTPServer(_ServerCore):
                 return
             if head is None:
                 return  # clean close: EOF, idle reap, or slowloris cut
+            # conn.since was stamped when the head's first byte arrived
+            # (_read_head's enter(HEAD)) — capture it BEFORE the body
+            # phase re-stamps it; this is the arrival the workload
+            # capture records for replay spacing
+            arrival = conn.since
             try:
                 method, path, headers, head = self._parse_head(head)
                 cls = route_class(method, path)
@@ -479,7 +490,7 @@ class EventHTTPServer(_ServerCore):
                 return  # client disconnected mid-body (or slow-body cut)
             conn.enter(_ConnState.BUSY)
             keep = await self._admit_and_dispatch(
-                writer, cls, head + body, deadline
+                writer, cls, head + body, deadline, arrival
             )
             if not keep:
                 return
@@ -571,7 +582,8 @@ class EventHTTPServer(_ServerCore):
             return None
 
     async def _admit_and_dispatch(self, writer, cls: str,
-                                  raw: bytes, deadline) -> bool:
+                                  raw: bytes, deadline,
+                                  arrival: float | None = None) -> bool:
         """Admission control + worker hand-off.  Returns False when the
         connection must close."""
         adm = self._admission[cls]
@@ -644,7 +656,7 @@ class EventHTTPServer(_ServerCore):
             )
             payload, close = await loop.run_in_executor(
                 self._pool, self._run_request, raw, writer, deadline,
-                direct_ok, wait_s,
+                direct_ok, wait_s, arrival,
             )
         finally:
             adm.in_flight -= 1
@@ -659,7 +671,8 @@ class EventHTTPServer(_ServerCore):
 
     def _run_request(self, raw: bytes, writer, deadline,
                      direct_ok: bool = False,
-                     admission_wait: float | None = None) -> tuple[bytes, bool]:
+                     admission_wait: float | None = None,
+                     arrival: float | None = None) -> tuple[bytes, bool]:
         """Worker-thread half: run the buffered request through the
         route table; returns (unsent response bytes, close_connection).
 
@@ -678,7 +691,8 @@ class EventHTTPServer(_ServerCore):
         returns to the loop."""
         peer = writer.get_extra_info("peername") or ("", 0)
         try:
-            h = _BufferedHandler(self, raw, peer, deadline, admission_wait)
+            h = _BufferedHandler(self, raw, peer, deadline, admission_wait,
+                                 arrival)
             out = h.wfile.getvalue()
             close = h.close_connection
             if not out:

@@ -65,6 +65,8 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
+    ("GET", re.compile(r"^/debug/workload$"), "debug_workload"),
+    ("GET", re.compile(r"^/debug/slo$"), "debug_slo"),
     ("GET", re.compile(r"^/debug/faults$"), "debug_faults"),
     ("POST", re.compile(r"^/debug/faults$"), "debug_faults_set"),
     ("DELETE", re.compile(r"^/debug/faults$"), "debug_faults_clear"),
@@ -125,6 +127,14 @@ class Handler(BaseHTTPRequestHandler):
         parsed = urlparse(self.path)
         self.query_params = parse_qs(parsed.query)
         self.route_name = ""
+        # per-request response/attribution state mined by the JSON
+        # access log (docs/workload.md): send_response/send_header
+        # overrides fill status + bytes, h_query fills the fingerprint
+        self._resp_status = 0
+        self._resp_bytes = 0
+        self._trace_id = None
+        self._workload_fp = None
+        t0 = time.perf_counter()
         # propagated trace context (coordinator → data plane): a remote
         # node's spans join the coordinator's trace and parent onto its
         # fan-out span instead of starting a disconnected trace
@@ -144,10 +154,14 @@ class Handler(BaseHTTPRequestHandler):
                     with self.stats.timer(
                         "http_request_seconds", tags={"route": name}
                     ):
-                        with GLOBAL_TRACER.span(f"http.{name}"):
+                        with GLOBAL_TRACER.span(f"http.{name}") as sp:
+                            self._trace_id = sp.trace_id
                             self._guarded(
                                 getattr(self, "h_" + name), *match.groups()
                             )
+                    self._access_log(
+                        method, parsed.path, time.perf_counter() - t0
+                    )
                     return
             # extra (/internal/*) routes get the same error mapping, a
             # span so remote data-plane work appears in the stitched
@@ -155,38 +169,98 @@ class Handler(BaseHTTPRequestHandler):
             with self.stats.timer(
                 "http_request_seconds", tags={"route": "internal"}
             ):
-                with GLOBAL_TRACER.span("http.internal", path=parsed.path):
+                with GLOBAL_TRACER.span("http.internal", path=parsed.path) as sp:
+                    self._trace_id = sp.trace_id
                     handled = self._guarded(
                         self.server.handle_extra, self, method, parsed.path
                     )
         if handled is False:
             self._json({"error": "not found"}, code=404)
+        self._access_log(method, parsed.path, time.perf_counter() - t0)
+
+    def _access_log(self, method: str, path: str, seconds: float) -> None:
+        """Structured JSON access log (config access-log-format=json,
+        docs/workload.md): one line per request — method, route,
+        status, latency, response bytes, trace id, and (query routes)
+        the workload fingerprint — so log pipelines index requests
+        without regexes.  Off by default; the status/bytes fields are
+        captured by the send_response/send_header overrides below, so
+        enabling it costs one json.dumps per request and nothing when
+        disabled."""
+        if not getattr(self.server, "access_log_json", False):
+            return
+        entry = {
+            "event": "access",
+            "method": method,
+            "path": path,
+            "route": self.route_name or "internal",
+            "status": self._resp_status,
+            "latencyMs": round(seconds * 1e3, 3),
+            "bytes": self._resp_bytes,
+            "traceId": self._trace_id,
+        }
+        if self._workload_fp is not None:
+            entry["fingerprint"] = self._workload_fp
+        self.server.log("access " + json.dumps(entry))
+
+    def send_response(self, code, message=None):
+        # the access log's status attribution: every response path
+        # (handlers, _error, send_error) funnels through here
+        self._resp_status = code
+        super().send_response(code, message)
+
+    def send_header(self, keyword, value):
+        if keyword.lower() == "content-length":
+            try:
+                self._resp_bytes = int(value)
+            except (TypeError, ValueError):
+                pass
+        super().send_header(keyword, value)
 
     def _guarded(self, fn, *args):
-        """Run a route handler with the error→status mapping applied."""
+        """Run a route handler with the error→status mapping applied.
+        The mapping itself lives in ``_error_status`` — the ONE table,
+        shared with the workload capture so the recorded status can
+        never drift from the status the client received."""
         try:
             return fn(*args)
-        except RequestTooLargeError as e:
-            self._error(str(e), code=413)
-        except (ExecutionError, PQLError, ValueError, KeyError) as e:
-            self._error(str(e), code=400)
-        except DeadlineExceededError as e:
-            # the labeled per-query timeout (docs/fault-tolerance.md):
-            # 504, never a generic 500/503 — a budget cut is the
-            # client's contract working, not a server fault
-            self._error(str(e), code=504)
-        except ShardUnavailableError as e:
-            self._error(str(e), code=503)
         except (BrokenPipeError, ConnectionResetError):
             pass  # client went away mid-response
         except Exception as e:  # pilosa: allow(broad-except) — the
-            # route error chokepoint: anything a handler leaks maps to a
-            # 500 response instead of killing the connection thread
+            # route error chokepoint: anything a handler leaks maps to
+            # a status via _error_status instead of killing the
+            # connection thread
+            code = self._error_status(e)
             if encoding.AVAILABLE and isinstance(e, encoding.DecodeError):
-                self._error(f"bad protobuf body: {e}", code=400)
+                self._error(f"bad protobuf body: {e}", code=code)
+            elif code == 500:
+                self._error(f"internal: {e!r}", code=code)
             else:
-                self._error(f"internal: {e!r}", code=500)
+                self._error(str(e), code=code)
         return None
+
+    @staticmethod
+    def _error_status(e: BaseException) -> int:
+        """The HTTP status a handler error maps to — the single source
+        for ``_guarded`` (the response) and the workload capture (the
+        recorded status).  Ordering matters only for subclass pairs:
+        RequestTooLargeError subclasses ExecutionError, so 413 checks
+        first; Deadline/ShardUnavailable/DecodeError are disjoint from
+        the 400 group (RuntimeError / protobuf Error bases)."""
+        if isinstance(e, RequestTooLargeError):
+            return 413
+        if isinstance(e, (ExecutionError, PQLError, ValueError, KeyError)):
+            return 400
+        if isinstance(e, DeadlineExceededError):
+            # the labeled per-query timeout (docs/fault-tolerance.md):
+            # 504, never a generic 500/503 — a budget cut is the
+            # client's contract working, not a server fault
+            return 504
+        if isinstance(e, ShardUnavailableError):
+            return 503
+        if encoding.AVAILABLE and isinstance(e, encoding.DecodeError):
+            return 400
+        return 500
 
     def _error(self, msg: str, code: int) -> None:
         """Error response in the negotiated wire format (reference:
@@ -406,8 +480,22 @@ class Handler(BaseHTTPRequestHandler):
                 "budgetS": qctx.deadline.budget_s,
                 "remainingS": qctx.deadline.remaining(),
             }
-        self._flightrec_settle(index, pql, prof, elapsed, err)
+        # workload fingerprint (docs/workload.md): the query's identity
+        # in the heavy-hitter sketch — computed once here (a cached
+        # dict hit on repeated traffic) and shared by the flight
+        # recorder entry, the slow-query log line, the access log, and
+        # the capture record below
+        wl = getattr(self.server, "workload", None)
+        fp = wl_call = None
+        if wl is not None and wl.enabled:
+            fp, wl_call = wl.fingerprint(index, pql, shards)
+            self._workload_fp = fp
+        self._flightrec_settle(index, pql, prof, elapsed, err, fp=fp, wl=wl)
         if err is not None:
+            self._workload_record(
+                wl, fp, wl_call, index, pql, prof, elapsed,
+                self._error_status(err), 0, shards=shards,
+            )
             raise err
         slow = self.server.long_query_time
         if slow > 0 and elapsed >= slow:
@@ -421,9 +509,11 @@ class Handler(BaseHTTPRequestHandler):
                     + (f" shards={shard_list}" if shard_list else "")
                     + f" ({worst['seconds']:.3f}s)"
                 )
+            rank = wl.rank(fp) if wl is not None and fp is not None else None
             self.server.log(
                 f"long query ({elapsed:.3f}s) index={index}"
-                f" trace={prof.trace_id}{where}: {pql[:200]}"
+                f" trace={prof.trace_id} fp={fp} rank={rank}{where}:"
+                f" {pql[:200]}"
             )
         if proto:
             self._proto(encoding.protoser.response_to_bytes(resp))
@@ -435,14 +525,54 @@ class Handler(BaseHTTPRequestHandler):
                 resp = dict(resp)
                 resp["explain"] = self._merge_explain_actuals(plan, prof)
             self._json(resp)
+        # recorded AFTER the response ships so the capture carries the
+        # real result size (send_header stashed Content-Length)
+        self._workload_record(
+            wl, fp, wl_call, index, pql, prof, elapsed, 200,
+            getattr(self, "_resp_bytes", 0), shards=shards,
+        )
+
+    def _workload_record(
+        self, wl, fp: str | None, call_type: str | None, index: str,
+        pql: str, prof, elapsed: float, status: int, nbytes: int,
+        shards: list[int] | None = None,
+    ) -> None:
+        """Feed the settled query to the workload plane: fingerprint →
+        sketch + per-fingerprint stats + SLO windows + (sampled) the
+        capture ring.  ``call_type`` comes from the fingerprinter's
+        parse (never ``_readback``, which can lead prof.calls under
+        wave concurrency).  The mutation stamp recorded alongside is
+        the cachability signal (docs/workload.md)."""
+        if wl is None or not wl.enabled or fp is None:
+            return
+        route = next(
+            (c.get("route") for c in prof.calls if c.get("route")), None
+        )
+        wl.record(
+            index,
+            pql,
+            fp,
+            call_type or "?",
+            elapsed,
+            status,
+            nbytes,
+            route=route,
+            trace_id=prof.trace_id,
+            stamp=self.api.mutation_stamp(index),
+            arrival=getattr(self, "arrival_monotonic", None),
+            shards=shards,
+        )
 
     def _flightrec_settle(
         self, index: str, pql: str, prof, elapsed: float,
-        err: BaseException | None,
+        err: BaseException | None, fp: str | None = None, wl=None,
     ) -> None:
         """Hand the settled query to the flight recorder — the evidence
         thunk (full profile + the trace's buffered spans) is only paid
-        when the recorder decides to retain."""
+        when the recorder decides to retain.  The entry carries the
+        query's workload fingerprint and its CURRENT heavy-hitter rank
+        (docs/workload.md), so a retained slow query links straight to
+        "how often does this exact query run" in /debug/workload."""
         rec = getattr(self.server, "flightrec", None)
         if rec is None or not rec.enabled:
             return
@@ -452,7 +582,7 @@ class Handler(BaseHTTPRequestHandler):
             call_type = pql.split("(", 1)[0].strip() or "?"
 
         def entry() -> dict:
-            return {
+            out = {
                 "traceId": prof.trace_id,
                 "index": index,
                 "query": pql[:500],
@@ -464,6 +594,13 @@ class Handler(BaseHTTPRequestHandler):
                     else []
                 ),
             }
+            if fp is not None:
+                out["fingerprint"] = fp
+                if wl is not None:
+                    # rank is resolved lazily HERE — only retained
+                    # queries pay the O(k) sketch walk
+                    out["workloadRank"] = wl.rank(fp)
+            return out
 
         rec.settle(call_type, elapsed, entry, error=err)
 
@@ -673,6 +810,12 @@ class Handler(BaseHTTPRequestHandler):
                 "compaction": self.api.holder.compactor.snapshot(),
             }
         )
+        # workload-intelligence plane health: capture ring depth,
+        # sampled/dropped counts, sketch size, spill segments — the
+        # analysis itself serves at /debug/workload (docs/workload.md)
+        out["workload"] = snapshot_envelope(
+            self.server.workload.vars_snapshot()
+        )
         self._json(out)
 
     def h_debug_flightrec(self) -> None:
@@ -707,6 +850,40 @@ class Handler(BaseHTTPRequestHandler):
             self._json(e)
             return
         self._json(rec.snapshot())
+
+    def h_debug_workload(self) -> None:
+        """The workload-intelligence report (docs/workload.md): top-K
+        heavy-hitter fingerprints with per-fingerprint latency/churn
+        stats and the cachability estimate.  ``?top=N`` bounds the
+        listing; ``?format=capture`` exports the sampled capture ring
+        as JSONL — directly consumable by ``pilosa_tpu replay`` (the
+        zero-config capture→replay path; spill segments on disk are
+        the durable alternative)."""
+        wl = getattr(self.server, "workload", None)
+        if wl is None:
+            self._json({"error": "workload plane not wired"}, code=404)
+            return
+        fmt = self.query_params.get("format", [""])[0]
+        if fmt == "capture":
+            body = "".join(
+                json.dumps(r, separators=(",", ":")) + "\n"
+                for r in wl.capture_records()
+            )
+            self._bytes(body.encode(), content_type="application/x-ndjson")
+            return
+        top = int(self.query_params.get("top", ["20"])[0])
+        self._json(wl.report(top=top))
+
+    def h_debug_slo(self) -> None:
+        """Per-call-type SLO state (docs/workload.md): burn rates over
+        the 5m/1h windows, budget remaining, and the parsed targets.
+        Gauges republish on scrape so /metrics agrees with this view."""
+        wl = getattr(self.server, "workload", None)
+        if wl is None:
+            self._json({"error": "workload plane not wired"}, code=404)
+            return
+        wl.slo.publish_gauges()
+        self._json(wl.slo.snapshot())
 
     def h_debug_traces(self) -> None:
         """Recent spans, or one trace by id. ``?trace_id=`` filters to a
@@ -890,6 +1067,19 @@ class _ServerCore:
         self.flightrec = FlightRecorder(
             stats=self.stats, log=lambda msg: self.log(msg)
         )
+        # workload-intelligence plane (docs/workload.md): continuous
+        # query capture + heavy-hitter sketch + SLO engine, fed by
+        # h_query at every settle.  Default-constructed like the flight
+        # recorder so embedded/standalone listeners measure too;
+        # Server.open replaces it with the config-sized one.
+        from pilosa_tpu.utils.workload import WorkloadPlane
+
+        self.workload = WorkloadPlane(
+            stats=self.stats, log=lambda msg: self.log(msg)
+        )
+        # structured JSON access log (config access-log-format=json);
+        # off by default — the access-log emitter checks this flag
+        self.access_log_json = False
         self.extra_routes: dict = {}
         # sync queries land in the API façade, which hands them to the
         # cross-query wave scheduler (api.scheduler) instead of calling

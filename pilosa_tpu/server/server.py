@@ -185,6 +185,36 @@ class Server:
             log=self.logger.log,
             enabled=self.config.flightrec_enabled,
         )
+        # config-sized workload-intelligence plane (docs/workload.md)
+        # replaces the listener's default one: capture ring + durable
+        # spill + heavy-hitter sketch + SLO engine. slo-targets parse
+        # failures raise HERE, at boot — a typo'd objective discovered
+        # when the dashboard stays empty would defeat the point.
+        from pilosa_tpu.utils.workload import WorkloadPlane
+
+        self.http.workload = WorkloadPlane(
+            enabled=self.config.workload_capture_enabled,
+            capacity=self.config.workload_capture_entries,
+            sample_rate=self.config.workload_sample_rate,
+            top_k=self.config.workload_top_k,
+            capture_path=(
+                os.path.expanduser(self.config.workload_capture_path)
+                if self.config.workload_capture_path
+                else None
+            ),
+            spill_max_bytes=self.config.workload_spill_max_bytes,
+            spill_max_age_s=self.config.workload_spill_max_age_s,
+            spill_segments=self.config.workload_spill_segments,
+            slo_targets=self.config.slo_targets,
+            stats=self.stats,
+            log=self.logger.log,
+        )
+        if self.config.access_log_format not in ("", "json"):
+            raise ValueError(
+                "access-log-format must be \"\" or \"json\", got "
+                f"{self.config.access_log_format!r}"
+            )
+        self.http.access_log_json = self.config.access_log_format == "json"
         self.http.long_query_time = self.config.long_query_time
         self.http.query_timeout_ms = self.config.query_timeout_ms
         self.http.fault_injector = self.fault_injector
@@ -426,6 +456,9 @@ class Server:
             self.cluster.close()
         self.api.scheduler.close()
         if self.http is not None:
+            # flush the open workload spill segment before the listener
+            # dies — a capture cut off mid-segment replays short
+            self.http.workload.close()
             self.http.shutdown()
             self.http.server_close()
         self.stats.close()

@@ -191,6 +191,43 @@ class Config:
     # /debug/vars routerAudit drift section; disable for the bench's
     # instrumented-off baseline
     router_audit_enabled: bool = True
+    # workload intelligence plane (docs/workload.md): always-on
+    # continuous capture of every settled public query (fingerprint,
+    # latency, route, status) feeding the heavy-hitter sketch, the
+    # cachability estimate, and GET /debug/workload. Disabling removes
+    # the plane from the settle path entirely (the bench's capture-off
+    # baseline).
+    workload_capture_enabled: bool = True
+    # in-memory capture ring capacity (records; oldest evict first)
+    workload_capture_entries: int = 4096
+    # fraction of settled queries recorded into the ring/spill
+    # (deterministic every-Nth sampling; the sketch and SLO engine
+    # observe every query regardless)
+    workload_sample_rate: float = 1.0
+    # heavy-hitter sketch size: distinct fingerprints tracked with full
+    # per-fingerprint stats (SpaceSaving top-K)
+    workload_top_k: int = 64
+    # directory for durable capture spill ("" = in-memory ring only):
+    # sampled records accumulate into size/age-bounded JSONL segments
+    # replayable by `pilosa_tpu replay`
+    workload_capture_path: str = ""
+    # spill segment bounds: a segment is cut when its buffered records
+    # exceed this many bytes or this age in seconds, whichever first
+    # (both evaluated as records arrive — an idle server's buffered
+    # tail flushes at shutdown; capture is best-effort by design)
+    workload_spill_max_bytes: int = 4_000_000
+    workload_spill_max_age_s: float = 60.0
+    # spill segments retained on disk (oldest deleted past the cap)
+    workload_spill_segments: int = 8
+    # SLO objectives (docs/workload.md grammar), comma/semicolon-
+    # separated: "<call>:p95<50ms:99.9" (99.9% of <call> queries settle
+    # OK within 50ms) or "<call>:errors:99.9" (availability only);
+    # "*" matches any call type. "" disables the SLO engine.
+    slo_targets: str = ""
+    # structured access log: "json" emits one JSON line per request
+    # (method, route, status, latency, bytes, trace id, fingerprint)
+    # to the server log sink; "" disables (the default)
+    access_log_format: str = ""
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -327,6 +364,16 @@ def config_template() -> str:
         "flightrec-entries = 256\n"
         "flightrec-min-ms = 25.0\n"
         "router-audit-enabled = true\n"
+        "workload-capture-enabled = true\n"
+        "workload-capture-entries = 4096\n"
+        "workload-sample-rate = 1.0\n"
+        "workload-top-k = 64\n"
+        'workload-capture-path = ""\n'
+        "workload-spill-max-bytes = 4000000\n"
+        "workload-spill-max-age-s = 60.0\n"
+        "workload-spill-segments = 8\n"
+        'slo-targets = ""\n'
+        'access-log-format = ""\n'
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

@@ -209,6 +209,12 @@ class FlightRecorder:
                         "reason": reason,
                         "query": (entry.get("query") or "")[:200],
                         "error": entry.get("error"),
+                        # workload linkage (docs/workload.md): this
+                        # exact query's fingerprint + current heavy-
+                        # hitter rank — "how often does this run" is
+                        # one /debug/workload lookup away
+                        "fingerprint": entry.get("fingerprint"),
+                        "workloadRank": entry.get("workloadRank"),
                     }
                 )
             )
@@ -251,6 +257,8 @@ class FlightRecorder:
                     "error",
                     "recordedAt",
                     "query",
+                    "fingerprint",
+                    "workloadRank",
                 )
                 if e.get(k) is not None
             }

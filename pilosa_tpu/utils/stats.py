@@ -83,6 +83,12 @@ _METRIC_HELP = {
     "flightrec_retained_total": "queries retained by the flight recorder",
     "router_misroute_total": "settled queries whose measured cost exceeded another route's estimate",
     "router_estimate_error_ratio": "measured over estimated cost for the chosen route",
+    "workload_observed_total": "settled public queries observed by the workload plane",
+    "workload_sampled_total": "queries recorded into the workload capture ring",
+    "workload_fingerprints_tracked": "distinct fingerprints held by the heavy-hitter sketch",
+    "workload_spill_segments": "workload capture spill segments on disk",
+    "slo_burn_rate": "error-budget burn rate per call type and window (1.0 = spending exactly the budget)",
+    "slo_budget_remaining": "fraction of the error budget left over the longest SLO window",
 }
 
 

@@ -81,6 +81,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "workload: workload-intelligence suite — fingerprinting, "
+        "heavy-hitter sketch, SLO burn rates, capture→replay "
+        "(tests/test_workload.py; runs in tier-1 — the marker exists so "
+        "`pytest -m workload` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -517,7 +517,7 @@ def test_asyncpurity_thread_spawn_in_coroutine_fails(tree_copy):
         tree_copy / "pilosa_tpu" / "server" / "eventloop.py",
         "payload, close = await loop.run_in_executor(\n"
         "                self._pool, self._run_request, raw, writer, deadline,\n"
-        "                direct_ok, wait_s,\n"
+        "                direct_ok, wait_s, arrival,\n"
         "            )",
         "_t = threading.Thread(\n"
         "                target=self._run_request, args=(raw, writer, deadline)\n"
@@ -765,6 +765,26 @@ def test_metric_drift_undocumented_registration_fails(tree_copy):
     rc, out = check_tree(tree_copy)
     assert rc != 0
     assert "[observability]" in out and "covert_channel_total" in out
+
+
+def test_metric_drift_covers_workload_families(tree_copy):
+    # ISSUE 11: the metric⇄docs check must cover the slo_*/workload_*
+    # families — dropping the slo_burn_rate catalog row leaves the
+    # registered gauge undocumented and the tree must go red
+    mutate(
+        tree_copy / "docs" / "observability.md",
+        "| `pilosa_tpu_slo_burn_rate` |",
+        "| `retired_slo_burn_rate` |",
+    )
+    mutate(
+        tree_copy / "docs" / "observability.md",
+        "| `pilosa_tpu_workload_observed_total` |",
+        "| `retired_workload_observed_total` |",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "slo_burn_rate" in out
+    assert "workload_observed_total" in out
 
 
 def test_metric_drift_stale_doc_row_fails(tree_copy):

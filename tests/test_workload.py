@@ -1,0 +1,738 @@
+"""Workload-intelligence suite (docs/workload.md).
+
+Covers the four-part plane end to end:
+
+- fingerprint canonicalization (whitespace / keyword-order / shard-set
+  normalization, value and index sensitivity);
+- SpaceSaving top-K correctness against exact counts on a Zipfian
+  fingerprint stream, with the error bound asserted;
+- SLO burn-rate window math on a fake clock, the target grammar, and
+  the 2-node fault-injected-delay acceptance scenario (burn rate flips
+  when a parallel/faultinject.py delay rule is armed);
+- capture ring + durable spill segments + capture→replay round-trip
+  status equivalence against a live server (including an errored query
+  — the divergence counter must see statuses reproduce exactly);
+- the HTTP surfaces: /debug/workload (top-K, cachability estimate,
+  ?top=, ?format=capture), /debug/slo, the /debug/vars workload
+  section under the snapshot envelope, the JSON access log, the
+  flight-recorder fingerprint/rank linkage, and overhead-off behavior
+  when workload-capture-enabled=false.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils.config import Config
+from pilosa_tpu.utils.workload import (
+    Fingerprinter,
+    SLOEngine,
+    SpaceSaving,
+    WorkloadPlane,
+    load_capture,
+    parse_slo_targets,
+    recorded_summary,
+    replay,
+)
+
+pytestmark = pytest.mark.workload
+
+
+def free_ports(k):
+    import socket
+
+    socks = [socket.socket() for _ in range(k)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+def call(port, body, path="/index/i/query", method="POST"):
+    data = (
+        body
+        if isinstance(body, (bytes, type(None)))
+        else json.dumps(body).encode()
+    )
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def get(port, path, raw=False):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as resp:
+        body = resp.read()
+    return body if raw else json.loads(body)
+
+
+# --------------------------------------------------------- fingerprints
+class TestFingerprint:
+    def test_whitespace_and_kwarg_order_normalized(self):
+        fp = Fingerprinter()
+        a = fp.fingerprint("i", "Count( Row(f=1) )", None)
+        b = fp.fingerprint("i", "Count(Row(f=1))", None)
+        c = fp.fingerprint("i", "Row(f=1, x=2)", None)
+        d = fp.fingerprint("i", "Row(x=2,  f=1)", None)
+        assert a == b
+        assert c == d
+        assert a[1] == "Count" and c[1] == "Row"
+
+    def test_identity_is_values_index_and_shards(self):
+        fp = Fingerprinter()
+        base = fp.fingerprint("i", "Count(Row(f=1))", None)[0]
+        assert fp.fingerprint("i", "Count(Row(f=2))", None)[0] != base
+        assert fp.fingerprint("j", "Count(Row(f=1))", None)[0] != base
+        assert fp.fingerprint("i", "Count(Row(f=1))", [0, 1])[0] != base
+        # shard ORDER and duplicates normalize away
+        assert (
+            fp.fingerprint("i", "Count(Row(f=1))", [1, 0, 1])[0]
+            == fp.fingerprint("i", "Count(Row(f=1))", [0, 1])[0]
+        )
+
+    def test_unparseable_query_still_fingerprints(self):
+        fp = Fingerprinter()
+        a = fp.fingerprint("i", "Nonsense(((", None)
+        b = fp.fingerprint("i", "Nonsense(((", None)
+        assert a == b and len(a[0]) == 16
+
+    def test_cache_hit_is_stable(self):
+        fp = Fingerprinter()
+        first = fp.fingerprint("i", "TopN(f, n=5)", None)
+        assert fp.fingerprint("i", "TopN(f, n=5)", None) == first
+
+
+# --------------------------------------------------------------- sketch
+class TestSpaceSaving:
+    def test_zipfian_topk_vs_exact(self, rng):
+        # Zipfian fingerprint stream: the sketch must track the true
+        # heavy hitters with its guaranteed error bound
+        draws = np.minimum(rng.zipf(1.3, 20_000), 2_000)
+        keys = [f"q{v}" for v in draws.tolist()]
+        exact = Counter(keys)
+        sk = SpaceSaving(64)
+        for k in keys:
+            sk.offer(k)
+        n = len(keys)
+        tracked = {k: (est, err) for k, est, err in sk.top()}
+        # SpaceSaving invariant: true ∈ [estimate - error, estimate],
+        # and the inherited error never exceeds N/k
+        for k, (est, err) in tracked.items():
+            assert est - err <= exact[k] <= est, (k, est, err, exact[k])
+            assert err <= n / 64
+        # every key with true frequency above N/k is guaranteed tracked
+        for k, c in exact.items():
+            if c > n / 64:
+                assert k in tracked, (k, c)
+        # the true top-5 are tracked and the sketch's #1 is the true #1
+        true_top = [k for k, _ in exact.most_common(5)]
+        assert set(true_top) <= set(tracked)
+        assert sk.top(1)[0][0] == true_top[0]
+        assert sk.rank(true_top[0]) == 1
+
+    def test_eviction_reports_victim(self):
+        sk = SpaceSaving(2)
+        sk.offer("a")
+        sk.offer("b")
+        assert sk.offer("c") in ("a", "b")
+        assert len(sk) == 2
+
+
+# ----------------------------------------------------------- SLO engine
+class TestSLO:
+    def test_grammar(self):
+        ts = parse_slo_targets("count:p95<50ms:99.9; topn:p99<1s:99, *:errors:99.99")
+        assert [t.call for t in ts] == ["count", "topn", "*"]
+        assert ts[0].threshold_s == pytest.approx(0.05)
+        assert ts[1].threshold_s == pytest.approx(1.0)
+        assert ts[2].threshold_s is None and ts[2].latency_budget is None
+        # two budgets per latency target: the percentile IS the
+        # latency budget, the trailing objective the availability one
+        assert ts[0].latency_budget == pytest.approx(0.05)
+        assert ts[0].avail_budget == pytest.approx(0.001)
+        assert ts[1].latency_budget == pytest.approx(0.01)
+
+    @pytest.mark.parametrize(
+        "bad", ["count", "count:p95<50ms", "count:q95<50ms:99", "count:p95<50ms:0",
+                "count:p95<50ms:100", "c:p95<50:99", "count:p0<50ms:99"]
+    )
+    def test_grammar_rejects(self, bad):
+        with pytest.raises(ValueError):
+            parse_slo_targets(bad)
+
+    def test_burn_rate_window_math_fake_clock(self):
+        t = [1000.0]
+        eng = SLOEngine("count:p95<50ms:99", clock=lambda: t[0])
+        # 96 good + 1 over-threshold + 3 errored over 100 queries:
+        # latency burn = (1/100)/0.05 = 0.2, availability burn =
+        # (3/100)/0.01 = 3.0 — the reported rate is the binding max
+        for _ in range(96):
+            eng.observe("Count", 0.001, error=False)
+        eng.observe("Count", 0.2, error=False)  # over the 50ms threshold
+        for _ in range(3):
+            eng.observe("Count", 0.001, error=True)
+        rates = eng.burn_rates("Count")
+        assert rates["5m"] == pytest.approx(3.0)
+        assert rates["1h"] == pytest.approx(3.0)
+        win = eng.snapshot()["calls"]["count"]["windows"]["5m"]
+        assert win["total"] == 100
+        assert win["overThreshold"] == 1 and win["errors"] == 3
+        assert win["latencyBurnRate"] == pytest.approx(0.2)
+        assert win["availabilityBurnRate"] == pytest.approx(3.0)
+        assert eng.budget_remaining("Count") == pytest.approx(-2.0)
+        # 6 minutes later the 5m window has rolled off; the 1h retains
+        t[0] += 360.0
+        rates = eng.burn_rates("Count")
+        assert rates["5m"] == 0.0
+        assert rates["1h"] == pytest.approx(3.0)
+        # 2 hours later everything rolled off; budget restored
+        t[0] += 7200.0
+        rates = eng.burn_rates("Count")
+        assert rates == {"5m": 0.0, "1h": 0.0}
+        assert eng.budget_remaining("Count") == pytest.approx(1.0)
+
+    def test_latency_quantile_is_honored(self):
+        # 2 of 10 queries over threshold: a p50 target (50% allowed
+        # over) burns at 0.4, a p95 target (5% allowed) at 4.0 — the
+        # configured percentile must change the math
+        loose = SLOEngine("count:p50<50ms:99.9")
+        tight = SLOEngine("count:p95<50ms:99.9")
+        for eng in (loose, tight):
+            for _ in range(8):
+                eng.observe("Count", 0.001, error=False)
+            for _ in range(2):
+                eng.observe("Count", 0.2, error=False)
+        assert loose.burn_rates("Count")["5m"] == pytest.approx(0.4)
+        assert tight.burn_rates("Count")["5m"] == pytest.approx(4.0)
+
+    def test_wildcard_call_cardinality_capped(self):
+        # client-controlled call types (unparseable PQL falls back to
+        # raw text) must not mint unbounded window pairs / gauge series
+        from pilosa_tpu.utils.workload import _MAX_SLO_CALLS
+
+        eng = SLOEngine("*:errors:99, count:errors:99")
+        for i in range(_MAX_SLO_CALLS + 50):
+            eng.observe(f"Garbage{i}", 0.001, error=False)
+        assert len(eng._windows) == _MAX_SLO_CALLS
+        # an explicitly-named target always tracks, even past the cap
+        eng.observe("Count", 0.001, error=True)
+        assert "count" in eng._windows
+        assert eng.burn_rates("Count")["5m"] > 0
+
+    def test_untargeted_call_is_ignored_and_wildcard_matches(self):
+        eng = SLOEngine("*:errors:99")
+        eng.observe("GroupBy", 5.0, error=False)  # slow but no latency target
+        eng.observe("GroupBy", 0.001, error=True)
+        rates = eng.burn_rates("GroupBy")
+        assert rates["5m"] == pytest.approx((1 / 2) / 0.01)
+        none_eng = SLOEngine("count:errors:99")
+        none_eng.observe("TopN", 0.001, error=True)
+        assert none_eng.burn_rates("TopN") == {"5m": 0.0, "1h": 0.0}
+        assert not SLOEngine("").enabled
+
+
+# ------------------------------------------------------- plane (unit)
+class TestWorkloadPlane:
+    def _rec(self, wl, pql="Count(Row(f=1))", stamp=(1, 1), status=200):
+        fp, ct = wl.fingerprint("i", pql, None)
+        wl.record("i", pql, fp, ct, 0.002, status, 16, route="host",
+                  stamp=stamp)
+        return fp
+
+    def test_stamp_churn_feeds_cachability(self):
+        wl = WorkloadPlane()
+        self._rec(wl, stamp=(1, 1))
+        self._rec(wl, stamp=(1, 1))  # unchanged: cache-servable
+        self._rec(wl, stamp=(2, 1))  # a write intervened
+        rep = wl.report()
+        (top,) = rep["topK"]
+        assert top["repeats"] == 2
+        assert top["repeatsUnchangedStamp"] == 1
+        assert top["stampChurn"] == pytest.approx(0.5)
+        assert rep["cachability"]["servableRepeats"] == 1
+        assert rep["cachability"]["servableQps"] > 0
+
+    def test_disabled_plane_records_nothing(self):
+        wl = WorkloadPlane(enabled=False)
+        fp, ct = wl.fingerprint("i", "Count(Row(f=1))", None)
+        wl.record("i", "Count(Row(f=1))", fp, ct, 0.1, 200, 1)
+        assert wl.observed == 0
+        assert wl.capture_records() == []
+        assert wl.report()["enabled"] is False
+
+    def test_sampling_every_nth(self):
+        wl = WorkloadPlane(sample_rate=0.5)
+        for _ in range(10):
+            self._rec(wl)
+        assert wl.observed == 10
+        assert wl.sampled == 5
+        assert wl.dropped == 5
+        assert len(wl.capture_records()) == 5
+        # ceil quantization: the effective rate never exceeds the
+        # configured one (round() would make 0.7 sample everything)
+        wl7 = WorkloadPlane(sample_rate=0.7)
+        for _ in range(10):
+            self._rec(wl7)
+        assert wl7.sampled == 5
+        assert wl7.vars_snapshot()["effectiveSampleRate"] == 0.5
+
+    def test_error_status_counts_as_error(self):
+        wl = WorkloadPlane()
+        fp = self._rec(wl, status=500)
+        rep = wl.report()
+        assert rep["topK"][0]["errors"] == 1
+        assert rep["topK"][0]["fingerprint"] == fp
+
+    def test_spill_segments_size_bounded_and_capped(self, tmp_path):
+        d = str(tmp_path / "cap")
+        wl = WorkloadPlane(
+            capture_path=d, spill_max_bytes=10, spill_segments=2
+        )
+        for i in range(5):
+            self._rec(wl, pql=f"Count(Row(f={i}))")
+        wl.close()
+        import os
+
+        segs = sorted(os.listdir(d))
+        # every record overflowed the 10-byte bound into its own
+        # segment; only the newest 2 survive the retention cap
+        assert len(segs) == 2
+        records = load_capture(d)
+        assert len(records) == 2
+        assert records[0]["t"] <= records[1]["t"]
+        summary = recorded_summary(records)
+        assert summary["perCall"]["Count"]["sent"] == 2
+
+    def test_load_capture_rejects_empty(self, tmp_path):
+        with pytest.raises(ValueError):
+            load_capture(str(tmp_path))
+
+    def test_capture_record_carries_shard_scope(self):
+        wl = WorkloadPlane()
+        fp, ct = wl.fingerprint("i", "Count(Row(f=1))", [2, 0, 2])
+        wl.record("i", "Count(Row(f=1))", fp, ct, 0.001, 200, 16,
+                  shards=[2, 0, 2])
+        (rec,) = wl.capture_records()
+        # normalized like the fingerprint: sorted, deduplicated —
+        # replay re-issues the same scope, not an all-shards variant
+        assert rec["shards"] == [0, 2]
+
+    def test_spill_sequence_resumes_across_restart(self, tmp_path):
+        d = str(tmp_path / "cap")
+        first = WorkloadPlane(capture_path=d)
+        self._rec(first, pql="Count(Row(f=1))")
+        first.close()
+        # a fresh plane over the same dir (a restarted server) must
+        # continue the sequence, not overwrite segment 1
+        second = WorkloadPlane(capture_path=d)
+        self._rec(second, pql="Count(Row(f=2))")
+        second.close()
+        import os
+
+        assert sorted(os.listdir(d)) == [
+            "workload-000001.jsonl", "workload-000002.jsonl",
+        ]
+        records = load_capture(d)
+        assert [r["pql"] for r in records] == [
+            "Count(Row(f=1))", "Count(Row(f=2))",
+        ]
+        # pre-existing segments count against the retention cap
+        third = WorkloadPlane(capture_path=d, spill_segments=2)
+        self._rec(third, pql="Count(Row(f=3))")
+        third.close()
+        assert sorted(os.listdir(d)) == [
+            "workload-000002.jsonl", "workload-000003.jsonl",
+        ]
+
+    def test_cross_boot_timeline_gaps_clamped(self):
+        # a capture spanning a restart has a negative monotonic jump at
+        # the boot boundary: the span must sum positive gaps only
+        records = [
+            {"t": 100.0, "call": "Count", "latencyS": 0.001, "status": 200},
+            {"t": 101.0, "call": "Count", "latencyS": 0.001, "status": 200},
+            {"t": 3.0, "call": "Count", "latencyS": 0.001, "status": 200},
+            {"t": 4.5, "call": "Count", "latencyS": 0.001, "status": 200},
+        ]
+        summary = recorded_summary(records)
+        assert summary["spanSeconds"] == pytest.approx(2.5)
+        assert summary["perCall"]["Count"]["qps"] == pytest.approx(4 / 2.5)
+
+
+# ----------------------------------------------------- HTTP single node
+@pytest.fixture(scope="module")
+def server(tmp_path_factory):
+    port = free_ports(1)[0]
+    cfg = Config(
+        bind=f"127.0.0.1:{port}",
+        data_dir=str(tmp_path_factory.mktemp("workload-data")),
+        anti_entropy_interval=0,
+        diagnostics_interval=0,
+        slo_targets="count:p95<1000ms:99",
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(120)
+    call(port, {}, path="/index/i")
+    call(port, {}, path="/index/i/field/f")
+    call(
+        port,
+        {"rowIDs": [1, 1, 2, 3], "columnIDs": [1, 2, 3, 4]},
+        path="/index/i/field/f/import",
+    )
+    yield s, port
+    s.close()
+
+
+def zipf_mix(port, rng, queries=60, rows=12):
+    """Drive a Zipfian mix of distinct Count queries; returns the exact
+    per-query counts."""
+    draws = np.minimum(rng.zipf(1.5, queries), rows).tolist()
+    for r in draws:
+        call(port, f"Count(Row(f={r}))".encode())
+    return Counter(f"Count(Row(f={r}))" for r in draws)
+
+
+class TestHTTPSurface:
+    def test_debug_workload_zipfian_topk_and_cachability(self, server, rng):
+        s, port = server
+        exact = zipf_mix(port, rng)
+        wr = get(port, "/debug/workload")
+        assert wr["enabled"] is True
+        assert wr["observed"] >= sum(exact.values())
+        # the true hottest query is the sketch's #1, under its own
+        # canonical fingerprint
+        hottest_pql, hottest_n = exact.most_common(1)[0]
+        want_fp = Fingerprinter().fingerprint("i", hottest_pql, None)[0]
+        top = wr["topK"][0]
+        assert top["fingerprint"] == want_fp
+        assert top["estimatedCount"] >= hottest_n
+        assert top["rank"] == 1 and top["call"] == "Count"
+        assert top["p95Ms"] >= 0
+        # ACCEPTANCE: nonzero cachability estimate — repeats with no
+        # interleaved writes are exactly what a stamped result cache
+        # would have served
+        cach = wr["cachability"]
+        assert cach["servableRepeats"] > 0
+        assert cach["servableQps"] > 0
+        assert 0 < cach["servableFraction"] <= 1
+
+    def test_debug_workload_top_param_and_json_format(self, server):
+        _s, port = server
+        wr = get(port, "/debug/workload?format=json&top=2")
+        assert len(wr["topK"]) == 2
+        assert get(port, "/debug/workload?top=1")["topK"][0]["rank"] == 1
+
+    def test_capture_export_and_replay_roundtrip(self, server):
+        """Capture→replay round trip: replayed statuses must be
+        bit-equivalent to the recorded ones — including an errored
+        query — so divergence stays 0."""
+        _s, port = server
+        for _ in range(3):
+            call(port, b"Count(Row(f=1))")
+        call(port, b"Count(Row(f=1))", path="/index/i/query?shards=0")
+        with pytest.raises(urllib.error.HTTPError):
+            call(port, b"Count(Row(ghost=1))")  # recorded as 400
+        raw = get(port, "/debug/workload?format=capture", raw=True)
+        lines = raw.decode().strip().splitlines()
+        records = [json.loads(ln) for ln in lines][-5:]
+        assert [r["status"] for r in records] == [200, 200, 200, 200, 400]
+        # the shard-scoped request's scope rides the record into replay
+        assert records[3]["shards"] == [0]
+        rep = replay(
+            records, f"http://127.0.0.1:{port}", closed_loop=2
+        )
+        assert rep["completed"] == 5
+        assert rep["divergence"] == 0  # 200s replay 200, the 400 replays 400
+        assert rep["errorRate"] == pytest.approx(0.2)
+        assert rep["perCall"]["Count"]["sent"] == 5
+        # open-loop pacing modes settle too
+        fast = replay(records, f"http://127.0.0.1:{port}", speed=1000.0)
+        assert fast["divergence"] == 0 and fast["completed"] == 5
+        paced = replay(records, f"http://127.0.0.1:{port}", qps=200.0)
+        assert paced["divergence"] == 0 and paced["completed"] == 5
+
+    def test_replay_cli(self, server, tmp_path, capsys):
+        _s, port = server
+        call(port, b"Count(Row(f=1))")
+        raw = get(port, "/debug/workload?format=capture", raw=True)
+        cap = tmp_path / "cap.jsonl"
+        cap.write_bytes(raw)
+        from pilosa_tpu import cli
+
+        rc = cli.main([
+            "replay", str(cap), "--host", f"127.0.0.1:{port}",
+            "--closed-loop", "1", "--json",
+        ])
+        assert rc == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["replay"]["divergence"] == 0
+        assert out["recorded"]["perCall"]["Count"]["sent"] >= 1
+
+    def test_replay_cli_divergence_exit_code(self, server, tmp_path, capsys):
+        """docs/workload.md: divergence is the exit-code signal — in
+        --json mode too."""
+        _s, port = server
+        call(port, b"Count(Row(f=1))")
+        raw = get(port, "/debug/workload?format=capture", raw=True)
+        rec = json.loads(raw.decode().strip().splitlines()[-1])
+        rec["status"] = 500  # tampered: live replay will answer 200
+        cap = tmp_path / "tampered.jsonl"
+        cap.write_text(json.dumps(rec) + "\n")
+        from pilosa_tpu import cli
+
+        rc = cli.main([
+            "replay", str(cap), "--host", f"127.0.0.1:{port}",
+            "--closed-loop", "1", "--json",
+        ])
+        assert rc == 1
+        assert json.loads(capsys.readouterr().out)["replay"]["divergence"] == 1
+
+    def test_replay_counts_non_http_endpoint_as_transport_failure(self):
+        """A garbage (non-HTTP) endpoint raises BadStatusLine — it must
+        land in transportFailures, not silently kill worker threads."""
+        import socket
+        import threading
+
+        lsock = socket.create_server(("127.0.0.1", 0))
+        gport = lsock.getsockname()[1]
+
+        def garbage_server():
+            for _ in range(4):
+                try:
+                    conn, _addr = lsock.accept()
+                except OSError:
+                    return
+                try:
+                    conn.recv(4096)
+                    conn.sendall(b"garbage\r\n")
+                finally:
+                    conn.close()
+
+        t = threading.Thread(target=garbage_server, daemon=True)
+        t.start()
+        records = [
+            {"t": 0.0, "index": "i", "pql": "Count(Row(f=1))",
+             "call": "Count", "status": 200}
+        ]
+        try:
+            rep = replay(
+                records, f"http://127.0.0.1:{gport}", closed_loop=1,
+                timeout=5.0,
+            )
+        finally:
+            lsock.close()
+        assert rep["completed"] == 0
+        assert rep["transportFailures"] == 1
+
+    def test_debug_vars_workload_section_enveloped(self, server):
+        _s, port = server
+        dv = get(port, "/debug/vars")
+        wl = dv["workload"]
+        # the PR 10 uniform snapshot envelope
+        assert "snapshotMonotonicS" in wl and "generatedAt" in wl
+        assert wl["enabled"] is True
+        assert wl["captureRingDepth"] > 0
+        assert wl["observed"] >= wl["sampled"]
+        assert wl["sketchSize"] > 0 and wl["sketchK"] == 64
+        assert wl["spillSegments"] == 0  # no capture path on this server
+
+    def test_workload_metrics_registered(self, server):
+        s, port = server
+        met = get(port, "/metrics", raw=True).decode()
+        assert "pilosa_tpu_workload_observed_total" in met
+        assert "pilosa_tpu_workload_sampled_total" in met
+        counters = s.stats.expvar()["counters"]
+        assert counters["workload_observed_total"] >= 1
+
+    def test_flightrec_entry_carries_fingerprint_and_rank(self, server):
+        _s, port = server
+        # twice: the first settle seeds the sketch, the second entry's
+        # lazily-resolved rank finds it
+        for _ in range(2):
+            with pytest.raises(urllib.error.HTTPError):
+                call(port, b"Count(Row(ghost2=1))")
+        fr = get(port, "/debug/flightrec")
+        want_fp = Fingerprinter().fingerprint(
+            "i", "Count(Row(ghost2=1))", None
+        )[0]
+        mine = [e for e in fr["entries"] if e.get("fingerprint") == want_fp]
+        assert mine, fr["entries"]
+        assert mine[0]["workloadRank"] is not None
+        full = get(port, f"/debug/flightrec?trace_id={mine[0]['traceId']}")
+        assert full["fingerprint"] == want_fp
+
+    def test_slo_reports_and_gauges(self, server):
+        s, port = server
+        call(port, b"Count(Row(f=1))")
+        slo = get(port, "/debug/slo")
+        assert slo["enabled"] is True
+        assert slo["targets"] == ["count:p95<1000ms:99"]
+        count = slo["calls"]["count"]
+        assert count["latencyThresholdMs"] == pytest.approx(1000.0)
+        assert count["latencyQuantile"] == pytest.approx(95.0)
+        assert count["windows"]["5m"]["total"] >= 1
+        # scraping /debug/slo republished the gauges
+        gauges = s.stats.expvar()["gauges"]
+        assert "slo_burn_rate{call=count,window=5m}" in gauges
+        assert "slo_budget_remaining{call=count}" in gauges
+
+
+# ---------------------------------------------------- capture-off server
+def test_capture_off_is_inert(tmp_path):
+    """workload-capture-enabled=false removes the plane from the settle
+    path: nothing observed, nothing sampled, report says so."""
+    port = free_ports(1)[0]
+    cfg = Config(
+        bind=f"127.0.0.1:{port}",
+        data_dir=str(tmp_path / "off"),
+        anti_entropy_interval=0,
+        diagnostics_interval=0,
+        workload_capture_enabled=False,
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(120)
+    try:
+        call(port, {}, path="/index/i")
+        call(port, {}, path="/index/i/field/f")
+        call(port, {"rowIDs": [1], "columnIDs": [1]},
+             path="/index/i/field/f/import")
+        for _ in range(3):
+            call(port, b"Count(Row(f=1))")
+        wr = get(port, "/debug/workload")
+        assert wr["enabled"] is False
+        assert wr["observed"] == 0 and wr["topK"] == []
+        dv = get(port, "/debug/vars")
+        assert dv["workload"]["enabled"] is False
+        assert dv["workload"]["captureRingDepth"] == 0
+        assert "workload_observed_total" not in dv["counters"]
+        raw = get(port, "/debug/workload?format=capture", raw=True)
+        assert raw == b""
+    finally:
+        s.close()
+
+
+# ------------------------------------------------------- JSON access log
+def test_json_access_log(tmp_path):
+    port = free_ports(1)[0]
+    log_path = tmp_path / "server.log"
+    cfg = Config(
+        bind=f"127.0.0.1:{port}",
+        data_dir=str(tmp_path / "al"),
+        anti_entropy_interval=0,
+        diagnostics_interval=0,
+        log_path=str(log_path),
+        access_log_format="json",
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(120)
+    try:
+        call(port, {}, path="/index/i")
+        call(port, {}, path="/index/i/field/f")
+        call(port, {"rowIDs": [1], "columnIDs": [1]},
+             path="/index/i/field/f/import")
+        call(port, b"Count(Row(f=1))")
+        get(port, "/status")
+    finally:
+        s.close()
+    entries = []
+    for line in log_path.read_text().splitlines():
+        if " access {" in line:
+            entries.append(json.loads(line.split(" access ", 1)[1]))
+    by_route = {e["route"]: e for e in entries}
+    q = by_route["query"]
+    assert q["method"] == "POST" and q["status"] == 200
+    assert q["latencyMs"] > 0 and q["bytes"] > 0
+    assert q["traceId"]
+    # the fingerprint rides the access log on query routes only
+    assert q["fingerprint"] == Fingerprinter().fingerprint(
+        "i", "Count(Row(f=1))", None
+    )[0]
+    assert "fingerprint" not in by_route["status"]
+    assert by_route["status"]["method"] == "GET"
+
+
+def test_bad_access_log_format_rejected(tmp_path):
+    cfg = Config(
+        bind="127.0.0.1:0",
+        data_dir=str(tmp_path / "bad"),
+        access_log_format="apache",
+    )
+    s = Server(cfg)
+    with pytest.raises(ValueError, match="access-log-format"):
+        s.open()
+    s.close()
+
+
+# ------------------------------------------------- 2-node acceptance e2e
+def test_slo_burn_rate_flips_under_injected_delay(tmp_path):
+    """THE acceptance scenario: burn rates sit at zero on a healthy
+    cluster and flip past 1.0 the moment a fault-injected latency
+    degradation (parallel/faultinject.py delay rule on the coordinator's
+    fan-out legs) is armed — alertable before users notice."""
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    ports = free_ports(2)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(2):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            replica_n=1,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+            heartbeat_interval=60.0,
+            slo_targets="count:p95<500ms:99.9",
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    for s in servers:
+        s.cluster._heartbeat_once()
+    try:
+        call(ports[0], {}, path="/index/i")
+        call(ports[0], {}, path="/index/i/field/f")
+        cols = [s * SHARD_WIDTH + 1 for s in range(8)]
+        call(
+            ports[0],
+            {"rowIDs": [1] * len(cols), "columnIDs": cols},
+            path="/index/i/field/f/import",
+        )
+        for _ in range(10):
+            call(ports[0], b"Count(Row(f=1))")  # healthy: well under 500ms
+        healthy = get(ports[0], "/debug/slo")["calls"]["count"]
+        assert healthy["windows"]["5m"]["burnRate"] == 0.0
+        assert healthy["budgetRemaining"] == pytest.approx(1.0)
+        # degrade: every outgoing fan-out leg pays a 1.2s injected delay
+        servers[0].fault_injector.set_rules(
+            [{"path": "/internal/query", "action": "delay",
+              "delay_ms": 1200.0}],
+            seed=11,
+        )
+        for _ in range(3):
+            call(ports[0], b"Count(Row(f=1))")  # now >500ms each
+        servers[0].fault_injector.clear()
+        degraded = get(ports[0], "/debug/slo")["calls"]["count"]
+        burn = degraded["windows"]["5m"]["burnRate"]
+        assert burn > 1.0, degraded  # the flip: budget burning too fast
+        assert degraded["windows"]["5m"]["overThreshold"] >= 3
+        assert degraded["budgetRemaining"] < 1.0
+        # the gauges flipped with it
+        gauges = servers[0].stats.expvar()["gauges"]
+        assert gauges["slo_burn_rate{call=count,window=5m}"] > 1.0
+    finally:
+        for s in servers:
+            s.close()
