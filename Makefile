@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload
+.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload bench-profile
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -35,6 +35,14 @@ bench-residency:
 # shape; exits non-zero if the always-on layer costs >3% p50
 bench-observability:
 	set -o pipefail; PILOSA_BENCH_ALL_CHILD=observability python bench_all.py | tee BENCH_OBS_r10.json
+
+# continuous profiling & saturation plane row (docs/profiling.md):
+# plane-on vs plane-off c1 p50 on the config8 count shape (exits
+# non-zero past 1.03x, inertness checked both ways) + the c1/c8/c32/c64
+# saturation sweep recording worker utilization, loop-lag p99, GIL-wait
+# estimate, and the binding-resource verdict per level
+bench-profile:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=profile python bench_all.py | tee BENCH_PROFILE_r12.json
 
 # workload-intelligence plane row (docs/workload.md): capture-on vs
 # capture-off c1 p50 on the config8 count shape (exits non-zero past

@@ -308,6 +308,74 @@ def cmd_replay(args) -> int:
     return 0 if report["divergence"] == 0 else 1
 
 
+def cmd_doctor(args) -> int:
+    """Snapshot the ENTIRE debug surface of a live node into one JSON
+    bundle for offline diagnosis (docs/profiling.md): walks the
+    directory served by ``GET /debug/`` — so a debug endpoint added to
+    the server is collected with no doctor change — plus the core
+    status/info/metrics routes.  Endpoints that fail are recorded as
+    errors, not fatal: a half-dead node is exactly when a bundle is
+    wanted."""
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+
+    def fetch(path: str, is_json: bool):
+        req = urllib.request.Request(root + path)
+        with urllib.request.urlopen(
+            req, context=_SSL_CTX, timeout=args.timeout
+        ) as resp:
+            raw = resp.read()
+            ctype = resp.headers.get("Content-Type", "")
+        # the response's own Content-Type wins over the index's hint:
+        # a doctor query string can change the representation (e.g.
+        # /debug/profile defaults to folded text but the bundle fetches
+        # ?format=speedscope, which is JSON)
+        if "application/json" in ctype or (is_json and not ctype):
+            return json.loads(raw or b"{}")
+        return {"text": raw.decode(errors="replace")}
+
+    bundle: dict = {"host": args.host, "endpoints": {}}
+    errors = 0
+
+    def collect(path: str, is_json: bool) -> None:
+        nonlocal errors
+        try:
+            bundle["endpoints"][path] = fetch(path, is_json)
+        except Exception as e:  # pilosa: allow(broad-except) — doctor's
+            # JOB is recording what a sick node could not answer
+            errors += 1
+            bundle["endpoints"][path] = {"doctorError": repr(e)}
+
+    for path in ("/status", "/info", "/version", "/schema"):
+        collect(path, True)
+    collect("/metrics", False)
+    try:
+        index = fetch("/debug/", True)
+    except Exception as e:  # pilosa: allow(broad-except) — fall back to
+        # nothing: the core routes above are already in the bundle
+        bundle["debugIndexError"] = repr(e)
+        index = {"endpoints": []}
+        errors += 1
+    bundle["debugIndex"] = index
+    for ep in index.get("endpoints", []):
+        q = ep.get("doctor")
+        if q is None:
+            continue
+        collect(ep["path"] + q, bool(ep.get("json", True)))
+    bundle["doctorErrors"] = errors
+    out = json.dumps(bundle, indent=None if args.compact else 2)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(out + "\n")
+        print(
+            f"doctor bundle: {len(bundle['endpoints'])} endpoints, "
+            f"{errors} errors -> {args.out}"
+        )
+    else:
+        print(out)
+    return 0 if errors == 0 else 1
+
+
 def cmd_config(args) -> int:
     from pilosa_tpu.utils.config import config_template, dump_config, load_config
 
@@ -450,6 +518,23 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-request timeout seconds")
     s.add_argument("--json", action="store_true", help="raw JSON report")
     s.set_defaults(fn=cmd_replay)
+
+    s = sub.add_parser(
+        "doctor",
+        help="snapshot every debug endpoint of a live node into one "
+             "JSON bundle",
+    )
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="host:port or https://host:port for TLS servers")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
+    s.add_argument("--out", default=None, metavar="FILE",
+                   help="write the bundle here instead of stdout")
+    s.add_argument("--timeout", type=float, default=15.0,
+                   help="per-endpoint timeout seconds")
+    s.add_argument("--compact", action="store_true",
+                   help="single-line JSON (default: indented)")
+    s.set_defaults(fn=cmd_doctor)
 
     s = sub.add_parser("config", help="print effective config")
     s.add_argument("--config", default=None)

@@ -32,7 +32,6 @@ from __future__ import annotations
 import hashlib
 import itertools
 import os
-import threading
 
 import numpy as np
 
@@ -41,7 +40,7 @@ _FRAGMENT_UIDS = itertools.count(1)
 from pilosa_tpu import roaring
 from pilosa_tpu.core.cache import NopCache, make_cache
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
-from pilosa_tpu.utils import durable
+from pilosa_tpu.utils import durable, saturation
 from pilosa_tpu.utils.log import Logger
 
 _LOG = Logger()  # stderr sink; recovery events must be loud
@@ -80,8 +79,14 @@ class Fragment:
         self.bitmap = roaring.Bitmap()
         self.cache = make_cache(cache_type, cache_size)
         self.op_n = 0
+        # bytes of framed ops pending in the on-disk log beyond the
+        # snapshot — the per-fragment WAL debt the /debug/resources
+        # ledger aggregates (replay time after a crash grows with it)
+        self.ops_bytes = 0
         self.max_op_n = MAX_OP_N
-        self._lock = threading.RLock()
+        # contention-counted (docs/profiling.md): every fragment's lock
+        # folds into the "fragment" family in /debug/saturation
+        self._lock = saturation.ContendedLock("fragment", reentrant=True)
         self._opened = False  # gates ops-log appends (see _append_op)
         # background compaction hand-off (core/compact.py), injected by
         # the owning View: when set, an over-threshold ops log queues a
@@ -191,9 +196,11 @@ class Fragment:
             )
             self.bitmap = roaring.Bitmap()
             self.op_n = 0
+            self.ops_bytes = 0
             return
         res = roaring.replay_ops_checked(self.bitmap, data[consumed:])
         self.op_n = res.n_ops
+        self.ops_bytes = res.good_bytes
         good_end = consumed + res.good_bytes
         if res.corrupt:
             rec["corrupt"] = True
@@ -231,8 +238,10 @@ class Fragment:
         standalone fragments."""
         if self.path is None or not self._opened or self._dropped:
             return
-        durable.append_wal(self.path, roaring.append_op(opcode, values))
+        framed = roaring.append_op(opcode, values)
+        durable.append_wal(self.path, framed)
         self.op_n += 1
+        self.ops_bytes += len(framed)
         if self.op_n > self.max_op_n:
             if self._compactor is not None:
                 self._compactor.request(self, reason="threshold")
@@ -250,9 +259,11 @@ class Fragment:
                 # anti-entropy merge) must not recreate the relinquished
                 # shard's file any more than a queued compaction may
                 self.op_n = 0
+                self.ops_bytes = 0
                 return
             self._write_snapshot()
             self.op_n = 0
+            self.ops_bytes = 0
 
     def _write_snapshot(self) -> None:
         # in-place compaction is safe here: callers hold _lock
@@ -314,11 +325,13 @@ class Fragment:
                 # inline write is the only correct form
                 self._write_snapshot()
                 self.op_n = 0
+                self.ops_bytes = 0
                 return True
             clone = roaring.Bitmap()
             clone._containers = dict(self.bitmap._containers)
             base_len = os.path.getsize(self.path)
             ops_at_clone = self.op_n
+            ops_bytes_at_clone = self.ops_bytes
             gen_at_clone = self._snap_gen
         data = roaring.serialize(clone)  # NOT in place: containers shared
         tmp = self.path + ".compacting"
@@ -339,6 +352,7 @@ class Fragment:
             durable.replace_durable(tmp, self.path)
             self._snap_gen += 1
             self.op_n -= ops_at_clone
+            self.ops_bytes = max(0, self.ops_bytes - ops_bytes_at_clone)
             return True
 
     # ------------------------------------------------------------- rows

@@ -20,12 +20,12 @@ restart-to-serving is bounded by the slowest fragment, not the sum.
 from __future__ import annotations
 
 import os
-import threading
 import shutil
 from concurrent.futures import ThreadPoolExecutor
 
 from pilosa_tpu.core.compact import Compactor
 from pilosa_tpu.core.index import Index, IndexOptions
+from pilosa_tpu.utils import saturation
 
 
 class _LoadPool(ThreadPoolExecutor):
@@ -48,7 +48,9 @@ class Holder:
     ):
         self.path = path
         self.indexes: dict[str, Index] = {}
-        self._create_lock = threading.Lock()
+        # contention-counted (docs/profiling.md): /debug/saturation's
+        # "holder" lock family
+        self._create_lock = saturation.ContendedLock("holder")
         # parallel cold-start fragment loading; <=1 loads serially
         self.load_workers = load_workers
         self.compactor = Compactor(workers=compaction_workers, stats=stats)
@@ -121,6 +123,32 @@ class Holder:
         idx.close()
         if idx.path and os.path.isdir(idx.path):
             shutil.rmtree(idx.path)
+
+    def wal_ledger(self) -> dict:
+        """Aggregate ops-log (WAL) debt across every open fragment — the
+        byte half of the /debug/resources durability row.  ``opsLogBytes``
+        is what a crash would replay; ``maxOpLogFill`` is the fullest
+        fragment's op_n/max_op_n fraction (1.0 = a fold is due)."""
+        ops_bytes = 0
+        pending_ops = 0
+        fragments = 0
+        worst_fill = 0.0
+        for idx in list(self.indexes.values()):
+            for field in list(idx.fields.values()):
+                for view in list(field.views.values()):
+                    for frag in list(view.fragments.values()):
+                        fragments += 1
+                        ops_bytes += frag.ops_bytes
+                        pending_ops += frag.op_n
+                        worst_fill = max(
+                            worst_fill, frag.op_n / max(1, frag.max_op_n)
+                        )
+        return {
+            "fragments": fragments,
+            "opsLogBytes": ops_bytes,
+            "pendingOps": pending_ops,
+            "maxOpLogFill": round(worst_fill, 4),
+        }
 
     def schema(self) -> list[dict]:
         """Schema description (reference: api.Schema)."""

@@ -24,7 +24,6 @@ abstracted out; jax.jit's own shape cache handles S/R/W changes.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Any, Callable
 
 import numpy as np
@@ -46,6 +45,7 @@ from pilosa_tpu.core import (
 from pilosa_tpu.core.timequantum import views_by_time_range
 from pilosa_tpu.pql import Call, Condition, coerce_timestamp
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
+from pilosa_tpu.utils import saturation
 
 
 class PlanError(ValueError):
@@ -141,28 +141,42 @@ def reset_stack_budget_cache() -> None:
     _budget_cache.clear()
 
 
-def _stack_budget() -> int:
-    """See StackCache.STACK_BYTES_BUDGET. Cached after first resolution
-    (device memory limits don't change mid-process)."""
+def stack_budget_if_resolved() -> int | None:
+    """The budget WITHOUT triggering resolution, or None while only the
+    HBM path (which initializes the JAX backend) could answer.  The
+    /debug/resources ledger reads through this: a control-plane scrape
+    during the device-probe window must never be the first jax call in
+    the process — that hang is exactly what the probe gate exists to
+    prevent, and debug routes do not pass through the gate."""
     if _budget_override:
         return _budget_override[0]
     if _budget_cache:
         return _budget_cache[0]
     env = os.environ.get("PILOSA_TPU_STACK_BUDGET")
-    if env:
-        budget = int(env)
-    else:
-        budget = 0
-        try:
-            stats = jax.local_devices()[0].memory_stats() or {}
-            # 70% of reported HBM even when that is below 2 GiB — the
-            # headroom matters more on small devices, not less
-            budget = int(int(stats.get("bytes_limit", 0)) * 0.7)
-        except Exception:  # pilosa: allow(broad-except) — memory_stats
-            # is backend-specific and raises backend-specific errors
-            pass  # backend without memory stats (e.g. CPU)
-        if budget <= 0:
-            budget = 2 << 30
+    return int(env) if env else None
+
+
+def _stack_budget() -> int:
+    """See StackCache.STACK_BYTES_BUDGET. Cached after first resolution
+    (device memory limits don't change mid-process)."""
+    # override → cache → env, shared with the non-initializing ledger
+    # accessor so /debug/resources and the enforced budget cannot drift
+    resolved = stack_budget_if_resolved()
+    if resolved is not None:
+        if not _budget_cache and not _budget_override:
+            _budget_cache.append(resolved)  # env path: memoize like HBM
+        return resolved
+    budget = 0
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        # 70% of reported HBM even when that is below 2 GiB — the
+        # headroom matters more on small devices, not less
+        budget = int(int(stats.get("bytes_limit", 0)) * 0.7)
+    except Exception:  # pilosa: allow(broad-except) — memory_stats
+        # is backend-specific and raises backend-specific errors
+        pass  # backend without memory stats (e.g. CPU)
+    if budget <= 0:
+        budget = 2 << 30
     _budget_cache.append(budget)
     return budget
 
@@ -232,7 +246,10 @@ class StackCache:
         self._tiered: "OrderedDict[tuple, Any]" = OrderedDict()
         self.mesh_ctx = mesh_ctx  # parallel.mesh.MeshContext | None
         self.stats = stats  # optional StatsClient for residency metrics
-        self._lock = threading.Lock()
+        # contention-counted (docs/profiling.md): /debug/saturation's
+        # "stack-cache" lock family — every stack build/eviction and
+        # route-time token check serializes here
+        self._lock = saturation.ContendedLock("stack-cache")
         # shared byte ledger across BOTH caches: the budget is an
         # AGGREGATE resident cap, not just per-stack — a per-entry check
         # alone would let two near-budget stacks coexist and OOM the

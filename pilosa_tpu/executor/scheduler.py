@@ -56,7 +56,7 @@ from pilosa_tpu.executor.executor import (
     unwrap_options,
 )
 from pilosa_tpu.pql import Call, parse
-from pilosa_tpu.utils import tracing
+from pilosa_tpu.utils import saturation, tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 BATCH_MODES = ("off", "adaptive", "always")
@@ -183,7 +183,11 @@ class WaveScheduler:
         self._executor_fn = executor_fn
         self.stats = stats
         self._clock = clock
-        self._lock = threading.Lock()
+        # contention-counted (docs/profiling.md): /debug/saturation's
+        # "scheduler" lock family.  NOTE: Condition.wait's re-acquire
+        # after notify counts as contention — that is real time a woken
+        # wave-mate spends waiting for the queue lock, not noise.
+        self._lock = saturation.ContendedLock("scheduler")
         # one condition over the queue/leadership state: enqueues and
         # wave completions notify; waiting submitters contend to lead
         self._cond = threading.Condition(self._lock)

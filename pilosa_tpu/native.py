@@ -239,6 +239,7 @@ def stack_fill(
             target=lib.u32_stack_fill,
             args=(srcs, _ptr(rows, ctypes.c_int64), n_shards, words,
                   _ptr(dst, ctypes.c_uint32), r0, r1),
+            name=f"native-fill-{t}",
         )
         th.start()
         ts.append(th)

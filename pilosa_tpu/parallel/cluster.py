@@ -818,6 +818,7 @@ class Cluster:
         interval = getattr(self.config, "heartbeat_interval", HEARTBEAT_INTERVAL)
         self._hb_timer = threading.Timer(interval, tick)
         self._hb_timer.daemon = True
+        self._hb_timer.name = "heartbeat"
         self._hb_timer.start()
 
     def _check_not_removed(self) -> None:

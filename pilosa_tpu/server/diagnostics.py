@@ -116,7 +116,9 @@ class DiagnosticsCollector:
             self._gate_on_device_verdict()
             self.flush()
 
-        self._first_flush = threading.Thread(target=first, daemon=True)
+        self._first_flush = threading.Thread(
+            target=first, daemon=True, name="diagnostics-first-flush"
+        )
         self._first_flush.start()
         self._schedule(interval)
 
@@ -141,6 +143,7 @@ class DiagnosticsCollector:
 
         self._timer = threading.Timer(interval, tick)
         self._timer.daemon = True
+        self._timer.name = "diagnostics-flush"
         self._timer.start()
 
     def close(self) -> None:

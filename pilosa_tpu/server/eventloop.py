@@ -312,10 +312,15 @@ class EventHTTPServer(_ServerCore):
             **kwargs,
         )
         sweeper = asyncio.ensure_future(self._sweep_slow_clients())
+        lag_probe = None
+        if self.saturation is not None and self.saturation.enabled:
+            lag_probe = asyncio.ensure_future(self._lag_probe())
         self._started.set()
         try:
             await self._stop.wait()
         finally:
+            if lag_probe is not None:
+                lag_probe.cancel()
             sweeper.cancel()
             server.close()
             await server.wait_closed()
@@ -368,6 +373,25 @@ class EventHTTPServer(_ServerCore):
                     # torn-down connection must not kill the watchdog
                     # for every other connection
                     continue
+
+    async def _lag_probe(self) -> None:
+        """The event-loop saturation probe (docs/profiling.md): a
+        scheduled wakeup per tick, recording how late the loop actually
+        ran it — the loop's run-queue delay, which is exactly what every
+        queued response write and head parse waits behind.  The same
+        tick samples each admission class's in-flight/limit fraction so
+        worker-pool utilization is a windowed distribution, not a
+        single scrape's instantaneous guess."""
+        interval = 0.1
+        mon = self.saturation
+        while True:
+            t0 = time.monotonic()
+            await asyncio.sleep(interval)
+            mon.observe_loop_lag(max(0.0, time.monotonic() - t0 - interval))
+            for cls, adm in self._admission.items():
+                mon.observe_worker_util(
+                    cls, adm.in_flight / max(1, adm.limit)
+                )
 
     def _loop_exception(self, loop, context) -> None:
         # an exception nothing awaited: a bug by definition (the

@@ -62,7 +62,11 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/info$"), "info"),
     ("GET", re.compile(r"^/version$"), "version"),
     ("GET", re.compile(r"^/metrics$"), "metrics"),
+    ("GET", re.compile(r"^/debug/?$"), "debug_index"),
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
+    ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
+    ("GET", re.compile(r"^/debug/saturation$"), "debug_saturation"),
+    ("GET", re.compile(r"^/debug/resources$"), "debug_resources"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
     ("GET", re.compile(r"^/debug/workload$"), "debug_workload"),
@@ -81,6 +85,27 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ),
     ("GET", re.compile(r"^/internal/fragment/nodes$"), "fragment_nodes"),
     ("POST", re.compile(r"^/internal/translate/keys$"), "translate_keys"),
+]
+
+
+# the debug-surface directory served by GET /debug/ — (path, one-line
+# description, serves-JSON, doctor query string or None to skip in the
+# `pilosa_tpu doctor` bundle).  Keep in lockstep with _ROUTES: a debug
+# route absent here is invisible to operators and to doctor.
+_DEBUG_ENDPOINTS: list[tuple[str, str, bool, str | None]] = [
+    ("/debug/", "this directory: every debug endpoint, one line each", True, None),
+    ("/debug/vars", "counters/gauges/histograms plus per-subsystem state snapshots", True, ""),
+    ("/debug/profile", "continuous profiler: folded flame-graph stacks (?seconds=N, ?segment=, ?format=speedscope|segments)", False, "?format=speedscope"),
+    ("/debug/saturation", "USE verdict: event-loop lag, worker utilization, GIL estimate, lock contention (?window=S)", True, ""),
+    ("/debug/resources", "unified per-subsystem used/limit/pressure resource ledger", True, ""),
+    ("/debug/flightrec", "retained slow/errored query evidence (?trace_id=, &format=perfetto)", True, ""),
+    ("/debug/workload", "heavy-hitter fingerprints + cachability estimate (?top=, ?format=capture)", True, ""),
+    ("/debug/slo", "per-call-type SLO burn rates and budget remaining", True, ""),
+    ("/debug/faults", "armed fault-injection rules, RPC + filesystem (POST/DELETE to arm/clear)", True, ""),
+    ("/debug/traces", "recent tracing spans (?trace_id=, ?format=chrome)", True, ""),
+    ("/debug/pprof/profile", "BLOCKING on-demand sampling profile (?seconds=, default 5)", False, "?seconds=1"),
+    ("/debug/pprof/goroutine", "current stack of every live thread", False, ""),
+    ("/debug/pprof/heap", "top allocation sites via tracemalloc (?top=)", True, ""),
 ]
 
 
@@ -600,6 +625,16 @@ class Handler(BaseHTTPRequestHandler):
                     # rank is resolved lazily HERE — only retained
                     # queries pay the O(k) sketch walk
                     out["workloadRank"] = wl.rank(fp)
+            sampler = getattr(self.server, "profiler", None)
+            if sampler is not None and sampler.enabled:
+                # continuous-profiler linkage (docs/profiling.md): the
+                # segment ids overlapping this query's wall-clock window
+                # — the retained slow query links straight to the flame
+                # graph that contains it (/debug/profile?segment=ID)
+                now = time.monotonic()
+                out["profilerSegments"] = sampler.segments_overlapping(
+                    now - elapsed, now
+                )
             return out
 
         rec.settle(call_type, elapsed, entry, error=err)
@@ -817,6 +852,194 @@ class Handler(BaseHTTPRequestHandler):
             self.server.workload.vars_snapshot()
         )
         self._json(out)
+
+    def h_debug_index(self) -> None:
+        """``GET /debug/``: the debug-surface directory — every debug
+        endpoint with a one-line description (there are a dozen now and
+        nothing listed them).  ``pilosa_tpu doctor`` walks this list to
+        snapshot the whole surface into one offline bundle, so a new
+        debug route added HERE is automatically collected.  The
+        ``doctor`` field reflects LIVE state: a healthy node with the
+        profiler configured off must not make doctor exit non-zero
+        over the 404 that endpoint correctly serves."""
+        prof = getattr(self.server, "profiler", None)
+        out = []
+        for p, d, j, q in _DEBUG_ENDPOINTS:
+            if p == "/debug/profile" and (prof is None or not prof.enabled):
+                q = None
+            out.append(
+                {"path": p, "description": d, "json": j, "doctor": q}
+            )
+        self._json({"endpoints": out})
+
+    def h_debug_profile(self) -> None:
+        """The continuous profiler's surface (docs/profiling.md): a
+        flame graph of the recent past, served instantly from the
+        segment ring — nothing to arm in advance.  ``?seconds=N`` merges
+        the segments covering the last N seconds, ``?segment=ID`` one
+        retained historical segment (the id a flight-recorder entry
+        carries), ``?format=speedscope`` speedscope.app JSON instead of
+        folded text, ``?format=segments`` the ring index."""
+        prof = getattr(self.server, "profiler", None)
+        if prof is None:
+            self._json({"error": "profiler not wired"}, code=404)
+            return
+        fmt = self.query_params.get("format", ["folded"])[0]
+        if fmt == "segments":
+            self._json(snapshot_envelope(prof.snapshot()))
+            return
+        if not prof.enabled:
+            self._json(
+                {"error": "profiler disabled (config profiler-enabled)"},
+                code=404,
+            )
+            return
+        seconds_raw = self.query_params.get("seconds", [""])[0]
+        segment_raw = self.query_params.get("segment", [""])[0]
+        seconds = float(seconds_raw) if seconds_raw else None
+        segment = int(segment_raw) if segment_raw else None
+        try:
+            if fmt in ("speedscope", "json"):
+                self._json(prof.speedscope(seconds=seconds, segment=segment))
+            else:
+                self._text(prof.folded(seconds=seconds, segment=segment))
+        except KeyError as e:
+            self._json({"error": str(e)}, code=404)
+
+    def h_debug_saturation(self) -> None:
+        """The USE-style saturation verdict (docs/profiling.md): event-
+        loop lag, worker-pool utilization, the GIL-wait estimate, and
+        hot-lock contention, each normalized to a [0,1] pressure, with
+        the binding resource named for the window (``?window=S``,
+        default 60)."""
+        mon = getattr(self.server, "saturation", None)
+        if mon is None:
+            self._json({"error": "saturation monitor not wired"}, code=404)
+            return
+        window = float(self.query_params.get("window", ["60"])[0])
+        self._json(
+            snapshot_envelope(
+                mon.report(
+                    window_s=window, serving=self.server.serving_snapshot()
+                )
+            )
+        )
+
+    def h_debug_resources(self) -> None:
+        """The unified resource ledger (docs/profiling.md): the byte
+        accounting scattered across the codebase — device residency
+        ledger, WAL/ops-log debt, compaction debt, the capture/tracer/
+        flight-recorder rings, connections, workers, process RSS —
+        consolidated into one per-subsystem used/limit/pressure view,
+        sorted so the fullest subsystem reads first."""
+        from pilosa_tpu.utils import durable, saturation
+        from pilosa_tpu.utils.tracing import MAX_SPANS
+
+        subs: dict[str, dict] = {}
+
+        def row(name: str, used, limit, unit: str, **extra) -> None:
+            pressure = (
+                round(used / limit, 4) if limit else None
+            )
+            subs[name] = {
+                "used": used,
+                "limit": limit or None,
+                "unit": unit,
+                "pressure": pressure,
+                **extra,
+            }
+            if self.stats is not None and pressure is not None:
+                self.stats.gauge(
+                    "resource_pressure", pressure, tags={"subsystem": name}
+                )
+            if unit == "bytes" and self.stats is not None:
+                self.stats.gauge(
+                    "resource_bytes", float(used), tags={"subsystem": name}
+                )
+
+        # device residency: the stack cache's aggregate byte ledger.
+        # The budget is read WITHOUT forcing resolution — the HBM query
+        # initializes the JAX backend, and this control-plane route does
+        # not pass the device-probe gate (limit reads None until a
+        # query resolved it)
+        from pilosa_tpu.executor import compile as query_compile
+
+        stacks = self.api.executor.compiler.stacks
+        row(
+            "deviceResidency",
+            stacks.resident_bytes,
+            query_compile.stack_budget_if_resolved(),
+            "bytes",
+        )
+        # WAL / ops-log debt (crash-replay bytes) + compaction queue
+        wal = self.api.holder.wal_ledger()
+        row(
+            "walOpsLog",
+            wal["opsLogBytes"],
+            None,
+            "bytes",
+            pendingOps=wal["pendingOps"],
+            fragments=wal["fragments"],
+            maxOpLogFill=wal["maxOpLogFill"],
+            fsync=durable.wal_snapshot(),
+        )
+        comp = self.api.holder.compactor
+        debt = comp.debt()
+        max_debt = getattr(self.server, "compaction_max_debt", 0) or 0
+        row("compaction", debt, max_debt, "compactions",
+            workers=comp.workers)
+        # evidence rings
+        rec = getattr(self.server, "flightrec", None)
+        if rec is not None:
+            row("flightrecRing", len(rec.entries()), rec.capacity, "entries",
+                enabled=rec.enabled)
+        wl = getattr(self.server, "workload", None)
+        if wl is not None:
+            ws = wl.vars_snapshot()
+            row("workloadCaptureRing", ws["captureRingDepth"],
+                ws["captureRingCapacity"], "entries", enabled=ws["enabled"])
+            row("workloadSpill", ws["spillSegments"], None, "segments",
+                pendingRecords=ws["spillPendingRecords"])
+        row("tracerRing", GLOBAL_TRACER.depth(), MAX_SPANS, "spans")
+        # serving front end: connections + per-class worker occupancy
+        serving = self.server.serving_snapshot()
+        row(
+            "connections",
+            serving.get("connectionsOpen", 0),
+            serving.get("maxConnections", 0) or None,
+            "connections",
+            mode=serving.get("mode"),
+        )
+        for cls, adm in (serving.get("admission") or {}).items():
+            row(
+                f"workers.{cls}",
+                adm["inFlight"],
+                adm["limit"],
+                "threads",
+                queueDepth=adm["queueDepth"],
+                queueCap=adm["queueCap"],
+            )
+        # process memory against the cgroup ceiling (if any)
+        rss = saturation.rss_bytes()
+        if rss is not None:
+            row("processRss", rss, saturation.memory_limit_bytes(), "bytes",
+                threads=threading.active_count())
+        ranked = sorted(
+            subs,
+            key=lambda k: -(subs[k]["pressure"] or 0.0),
+        )
+        self._json(
+            snapshot_envelope(
+                {
+                    "subsystems": {k: subs[k] for k in ranked},
+                    "fullest": (
+                        ranked[0]
+                        if ranked and subs[ranked[0]]["pressure"]
+                        else None
+                    ),
+                }
+            )
+        )
 
     def h_debug_flightrec(self) -> None:
         """The flight recorder's surface (docs/observability.md):
@@ -1077,6 +1300,18 @@ class _ServerCore:
         self.workload = WorkloadPlane(
             stats=self.stats, log=lambda msg: self.log(msg)
         )
+        # continuous sampling profiler (docs/profiling.md): Server.open
+        # installs a config-sized, STARTED SamplingProfiler; embedded/
+        # standalone listeners leave it None (/debug/profile 404s) —
+        # starting a sampler thread must be an explicit choice
+        self.profiler = None
+        # saturation probes (docs/profiling.md): default-constructed so
+        # the event loop's lag probe and the lock families report even
+        # on embedded listeners; the GIL probe thread only starts when
+        # Server.open calls saturation.start()
+        from pilosa_tpu.utils.saturation import SaturationMonitor
+
+        self.saturation = SaturationMonitor(stats=self.stats)
         # structured JSON access log (config access-log-format=json);
         # off by default — the access-log emitter checks this flag
         self.access_log_json = False
@@ -1167,8 +1402,16 @@ class ThreadedHTTPServer(_ServerCore, ThreadingHTTPServer):
             )
         return sock, addr
 
+    def process_request_thread(self, request, client_address):
+        # name the per-connection thread so profiler samples attribute
+        # to the listener subsystem instead of "Thread-12"
+        threading.current_thread().name = "http-threaded-conn"
+        super().process_request_thread(request, client_address)
+
     def serve_background(self) -> threading.Thread:
-        t = threading.Thread(target=self.serve_forever, daemon=True)
+        t = threading.Thread(
+            target=self.serve_forever, daemon=True, name="http-accept"
+        )
         t.start()
         return t
 

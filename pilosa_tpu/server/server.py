@@ -107,6 +107,7 @@ class Server:
             batch_max_queries=self.config.batch_max_queries,
         )
         self.http: HTTPServer | None = None
+        self.profiler = None
         self.diagnostics = None
         self._anti_entropy_timer: threading.Timer | None = None
         self._closed = False
@@ -209,6 +210,31 @@ class Server:
             stats=self.stats,
             log=self.logger.log,
         )
+        # continuous profiling + saturation plane (docs/profiling.md):
+        # the config-sized sampler replaces the listener's None slot and
+        # STARTS here — a flame graph of the last minute is one curl
+        # away for the life of the process; the saturation monitor gets
+        # the module-level metrics sink (hot locks are constructed deep
+        # inside core/executor where no client is in scope) and its GIL
+        # probe thread
+        from pilosa_tpu.utils import saturation
+        from pilosa_tpu.utils.profiler import SamplingProfiler
+
+        saturation.set_stats(self.stats)
+        self.profiler = SamplingProfiler(
+            hz=self.config.profiler_hz,
+            segment_s=self.config.profiler_segment_s,
+            segments=self.config.profiler_segments,
+            stats=self.stats,
+            enabled=self.config.profiler_enabled,
+        )
+        self.profiler.start()
+        self.http.profiler = self.profiler
+        self.http.saturation = saturation.SaturationMonitor(
+            stats=self.stats,
+            enabled=self.config.saturation_probes_enabled,
+        )
+        self.http.saturation.start()
         if self.config.access_log_format not in ("", "json"):
             raise ValueError(
                 "access-log-format must be \"\" or \"json\", got "
@@ -429,6 +455,7 @@ class Server:
 
         self._anti_entropy_timer = threading.Timer(interval, tick)
         self._anti_entropy_timer.daemon = True
+        self._anti_entropy_timer.name = "anti-entropy"
         self._anti_entropy_timer.start()
 
     @property
@@ -455,7 +482,10 @@ class Server:
         if self.cluster is not None:
             self.cluster.close()
         self.api.scheduler.close()
+        if self.profiler is not None:
+            self.profiler.stop()
         if self.http is not None:
+            self.http.saturation.stop()
             # flush the open workload spill segment before the listener
             # dies — a capture cut off mid-segment replays short
             self.http.workload.close()

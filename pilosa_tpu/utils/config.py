@@ -186,6 +186,25 @@ class Config:
     # floor under the rolling p95 retention threshold, in milliseconds —
     # a uniformly fast call type must not retain its own p95 noise
     flightrec_min_ms: float = 25.0
+    # continuous profiling plane (docs/profiling.md): a background
+    # sampler over sys._current_frames() aggregates folded stacks into
+    # a ring of rotating time segments so GET /debug/profile serves a
+    # flame graph of the recent past instantly. Disabling removes the
+    # sampler thread entirely (the bench's profiler-off baseline).
+    profiler_enabled: bool = True
+    # samples per second; the overhead gate (make bench-profile) holds
+    # at the default — raise for finer stacks on a box with headroom
+    profiler_hz: float = 20.0
+    # seconds per ring segment, and retained segments: history depth is
+    # segment-s × segments (defaults: 16 minutes)
+    profiler_segment_s: float = 60.0
+    profiler_segments: int = 16
+    # saturation probes (docs/profiling.md): the event-loop lag probe,
+    # worker-utilization sampling, and the GIL-contention estimator
+    # thread behind GET /debug/saturation. Lock-contention counting is
+    # structural (the shim costs one nonblocking attempt) and stays on
+    # regardless.
+    saturation_probes_enabled: bool = True
     # settle-time router-decision audit (docs/query-routing.md):
     # router_misroute_total / router_estimate_error_ratio and the
     # /debug/vars routerAudit drift section; disable for the bench's
@@ -363,6 +382,11 @@ def config_template() -> str:
         "flightrec-enabled = true\n"
         "flightrec-entries = 256\n"
         "flightrec-min-ms = 25.0\n"
+        "profiler-enabled = true\n"
+        "profiler-hz = 20.0\n"
+        "profiler-segment-s = 60.0\n"
+        "profiler-segments = 16\n"
+        "saturation-probes-enabled = true\n"
         "router-audit-enabled = true\n"
         "workload-capture-enabled = true\n"
         "workload-capture-entries = 4096\n"

@@ -81,6 +81,14 @@ _METRIC_HELP = {
     "rows_demoted": "resident rows LRU-demoted back to host-only serving",
     "residency_bytes": "device bytes held by tiered container stores",
     "flightrec_retained_total": "queries retained by the flight recorder",
+    "profiler_samples_total": "stack samples taken by the continuous profiler",
+    "eventloop_lag_seconds": "scheduled-callback wakeup delay on the event loop",
+    "gil_wait_seconds": "cross-thread no-op wakeup overshoot (GIL-contention estimate)",
+    "worker_utilization": "sampled in-flight/limit fraction per admission class",
+    "lock_wait_seconds": "time blocked acquiring a contended hot lock, per family",
+    "lock_contended_total": "contended acquires per hot-lock family",
+    "resource_pressure": "used/limit fraction per resource-ledger subsystem",
+    "resource_bytes": "bytes used per resource-ledger subsystem",
     "router_misroute_total": "settled queries whose measured cost exceeded another route's estimate",
     "router_estimate_error_ratio": "measured over estimated cost for the chosen route",
     "workload_observed_total": "settled public queries observed by the workload plane",

@@ -203,6 +203,12 @@ class Tracer:
         with self._lock:
             return [s.to_json() for s in list(self._spans)[-n:]]
 
+    def depth(self) -> int:
+        """Buffered span count (the /debug/resources tracer-ring row —
+        counting must not pay for serializing 4k spans)."""
+        with self._lock:
+            return len(self._spans)
+
     def spans_for_trace(self, trace_id: str) -> list[dict]:
         """Every buffered span belonging to one trace (served to peers by
         GET /internal/trace for cross-node stitching)."""

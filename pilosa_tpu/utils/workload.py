@@ -1086,8 +1086,8 @@ def replay(
             client.close()
 
     threads = [
-        threading.Thread(target=worker, daemon=True)
-        for _ in range(n_workers)
+        threading.Thread(target=worker, daemon=True, name=f"replay-worker-{i}")
+        for i in range(n_workers)
     ]
     for t in threads:
         t.start()

@@ -88,6 +88,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "profiler: continuous profiling & saturation plane suite — "
+        "sampling profiler attribution, segment ring, saturation "
+        "probes/verdict, lock-contention shim, resource ledger, doctor "
+        "(tests/test_profiler.py; runs in tier-1 — the marker exists so "
+        "`pytest -m profiler` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -474,8 +474,8 @@ def test_durability_bare_oplog_append_fails(tree_copy):
     # die in the page cache and the chaos suite would never know
     mutate(
         tree_copy / "pilosa_tpu" / "core" / "fragment.py",
-        "durable.append_wal(self.path, roaring.append_op(opcode, values))",
-        'open(self.path, "ab").write(roaring.append_op(opcode, values))',
+        "durable.append_wal(self.path, framed)",
+        'open(self.path, "ab").write(framed)',
     )
     rc, out = check_tree(tree_copy)
     assert rc != 0

@@ -1,0 +1,480 @@
+"""Continuous profiling & saturation plane (docs/profiling.md).
+
+Covers the ISSUE 12 acceptance contracts: the sampling profiler
+attributes a synthetic hot function (≥80% of its thread's samples), an
+injected ``time.sleep`` on a loop callback flips the event-loop-lag
+histogram, the lock shim counts a forced two-thread contention, and the
+plane is inert when configured off.  Plus the segment ring, speedscope
+export, the ``/debug/`` directory, the flight-recorder segment linkage,
+the unified resource ledger, and the ``doctor`` bundle.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from pilosa_tpu import cli
+from pilosa_tpu.server import Server
+from pilosa_tpu.utils import saturation
+from pilosa_tpu.utils.config import Config
+from pilosa_tpu.utils.profiler import SamplingProfiler, subsystem_of
+from pilosa_tpu.utils.saturation import (
+    ContendedLock,
+    GILProbe,
+    LagRing,
+    SaturationMonitor,
+)
+from pilosa_tpu.utils.stats import StatsClient
+
+pytestmark = pytest.mark.profiler
+
+
+def make_server(tmp_path, **kw) -> Server:
+    cfg = Config(
+        bind="127.0.0.1:0",
+        data_dir=str(tmp_path / "data"),
+        anti_entropy_interval=0,
+        diagnostics_interval=0,
+        **kw,
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(30)
+    return s
+
+
+@pytest.fixture
+def srv(tmp_path):
+    s = make_server(tmp_path)
+    yield s
+    s.close()
+
+
+def call(srv, method, path, body=None, raw=False):
+    url = f"http://127.0.0.1:{srv.port}{path}"
+    data = (
+        body
+        if isinstance(body, (bytes, type(None)))
+        else json.dumps(body).encode()
+    )
+    req = urllib.request.Request(url, data=data, method=method)
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        payload = resp.read()
+        return payload if raw else json.loads(payload or b"{}")
+
+
+def seed_index(srv):
+    call(srv, "POST", "/index/i", {})
+    call(srv, "POST", "/index/i/field/f", {})
+    call(srv, "POST", "/index/i/query", b"Set(1, f=1) Set(3, f=1)")
+
+
+# ---------------------------------------------------------------- profiler
+def _hot_spin(stop: threading.Event) -> None:
+    x = 0
+    while not stop.is_set():
+        x += 1  # pure-Python busy loop: every sample lands here
+
+
+def test_profiler_attributes_hot_function():
+    """ISSUE 12 acceptance: a synthetic hot function receives >=80% of
+    the samples attributed to its thread."""
+    prof = SamplingProfiler(hz=100, segment_s=300)
+    stop = threading.Event()
+    t = threading.Thread(
+        target=_hot_spin, args=(stop,), daemon=True, name="hot-worker"
+    )
+    t.start()
+    prof.start()
+    try:
+        time.sleep(1.0)
+    finally:
+        prof.stop()
+        stop.set()
+        t.join()
+    hot_total = hot_in_spin = 0
+    for line in prof.folded().splitlines():
+        if not line.startswith("hot-worker;"):
+            continue
+        stack, _, n = line.rpartition(" ")
+        hot_total += int(n)
+        if "_hot_spin" in stack:
+            hot_in_spin += int(n)
+    assert hot_total >= 20, "profiler barely sampled the hot thread"
+    assert hot_in_spin / hot_total >= 0.8
+
+
+def test_profiler_off_is_inert():
+    """With the knob off, start() spawns nothing and nothing samples."""
+    stats = StatsClient()
+    prof = SamplingProfiler(hz=100, stats=stats, enabled=False)
+    prof.start()
+    time.sleep(0.1)
+    assert prof._thread is None
+    assert all(t.name != "profiler" for t in threading.enumerate())
+    assert prof.segments_info()[-1]["samples"] == 0
+    assert "profiler_samples_total" not in stats.expvar()["counters"]
+
+
+def test_segment_ring_rotation_and_windows():
+    """Fake-clock rotation: segments seal at segment_s, the ring caps
+    retention, ?seconds merges only covering segments, ?segment selects
+    one, and a missing id raises."""
+    now = [1000.0]
+    prof = SamplingProfiler(
+        hz=10, segment_s=10.0, segments=2, clock=lambda: now[0]
+    )
+    for _ in range(5):
+        prof.sample_once()
+    assert prof.current_segment_id == 0
+    now[0] += 10.0
+    prof.sample_once()  # crosses the boundary: seals segment 0
+    assert prof.current_segment_id == 1
+    for _ in range(3):
+        now[0] += 10.0
+        prof.sample_once()
+    infos = prof.segments_info()
+    assert infos[-1]["id"] == prof.current_segment_id
+    assert len(infos) == 3  # ring cap 2 + current
+    assert [i["id"] for i in infos] == [2, 3, 4]  # 0/1 evicted
+    # windows
+    assert prof.segments_overlapping(1000.0 + 35, 1000.0 + 36) == [3]
+    folded_one = prof.folded(segment=3)
+    assert "segment 3" in folded_one.splitlines()[0]
+    folded_recent = prof.folded(seconds=5.0)
+    assert "last 5s" in folded_recent.splitlines()[0]
+    with pytest.raises(KeyError):
+        prof.folded(segment=0)
+
+
+def test_speedscope_export_shape():
+    prof = SamplingProfiler(hz=50, segment_s=300)
+    prof.start()
+    time.sleep(0.3)
+    prof.stop()
+    ss = prof.speedscope()
+    assert ss["$schema"].startswith("https://www.speedscope.app/")
+    p = ss["profiles"][0]
+    assert p["type"] == "sampled" and p["unit"] == "seconds"
+    assert len(p["samples"]) == len(p["weights"]) > 0
+    n_frames = len(ss["shared"]["frames"])
+    assert all(0 <= i < n_frames for s in p["samples"] for i in s)
+    # weights are stack counts scaled by 1/hz — their sum matches the
+    # folded table's total weight exactly
+    stack_total = sum(
+        int(line.rpartition(" ")[2])
+        for line in prof.folded().splitlines()[1:]
+    )
+    assert abs(sum(p["weights"]) - stack_total / 50.0) < 1e-6
+    assert p["endValue"] == pytest.approx(sum(p["weights"]))
+
+
+def test_subsystem_folding():
+    assert subsystem_of("http-worker_3") == "http-worker"
+    assert subsystem_of("compactor-12") == "compactor"
+    assert subsystem_of("MainThread") == "MainThread"
+
+
+# -------------------------------------------------------------- lock shim
+def test_lock_shim_counts_forced_contention():
+    """ISSUE 12 acceptance: a forced two-thread contention is counted,
+    with the wait time recorded and the metrics emitted."""
+    stats = StatsClient()
+    prev = saturation._stats
+    saturation.set_stats(stats)
+    try:
+        lock = ContendedLock("testfam")
+        base = lock.family.contended
+        hold = threading.Event()
+
+        def holder():
+            with lock:
+                hold.set()
+                time.sleep(0.12)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        hold.wait(5)
+        t0 = time.monotonic()
+        with lock:
+            waited = time.monotonic() - t0
+        t.join()
+        assert lock.family.contended == base + 1
+        assert waited >= 0.05
+        snap = lock.family.snapshot(window_s=60)
+        assert snap["windowContended"] >= 1
+        assert snap["windowWaitSeconds"] >= 0.05
+        counters = stats.expvar()["counters"]
+        assert counters.get("lock_contended_total{lock=testfam}") == 1
+        hist = stats.histogram("lock_wait_seconds", tags={"lock": "testfam"})
+        assert hist is not None and hist.count == 1
+    finally:
+        saturation.set_stats(prev)
+
+
+def test_lock_shim_uncontended_fast_path_records_nothing():
+    lock = ContendedLock("fastfam")
+    contended_before = lock.family.contended
+    for _ in range(10):
+        with lock:
+            pass
+    assert lock.family.contended == contended_before
+    assert lock.family.acquisitions >= 10
+
+
+def test_lock_shim_reentrant_and_condition():
+    r = ContendedLock("reent", reentrant=True)
+    with r:
+        with r:  # reentrancy must not deadlock or count contention
+            pass
+    c = threading.Condition(ContendedLock("condfam"))
+    fired = []
+
+    def waiter():
+        with c:
+            fired.append(c.wait(timeout=5))
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    time.sleep(0.05)
+    with c:
+        c.notify()
+    t.join(5)
+    assert fired == [True]
+
+
+# ---------------------------------------------------------- saturation
+def test_gil_probe_runs_and_records():
+    probe = GILProbe(interval_s=0.01)
+    probe.start()
+    time.sleep(0.25)
+    probe.stop()
+    w = probe.lag.window(60)
+    assert w["count"] >= 5
+    assert w["p99"] < 5.0  # sanity: the overshoot is a delay, not hours
+
+
+def test_saturation_verdict_names_binding_resource():
+    mon = SaturationMonitor(enabled=True)
+    for _ in range(20):
+        mon.observe_worker_util("query", 1.0)
+        mon.observe_loop_lag(0.0005)
+    rep = mon.report(window_s=60)
+    assert rep["binding"] == "worker-pool"
+    assert rep["pressures"]["worker-pool"] == 1.0
+    # a dominant GIL signal wins instead
+    mon2 = SaturationMonitor(enabled=True)
+    for _ in range(20):
+        mon2.gil.lag.observe(0.2)
+        mon2.observe_worker_util("query", 0.1)
+    rep2 = mon2.report(window_s=60)
+    assert rep2["binding"] == "gil"
+    # idle process: no binding resource
+    assert SaturationMonitor(enabled=True).report(60)["binding"] == "none"
+
+
+def test_lag_ring_windowing():
+    ring = LagRing()
+    ring.observe(1.0, t=time.monotonic() - 120)  # outside the window
+    ring.observe(0.5)
+    w = ring.window(60)
+    assert w["count"] == 1 and w["max"] == 0.5
+
+
+def test_eventloop_sleep_flips_lag_histogram(srv):
+    """ISSUE 12 acceptance: an injected time.sleep on a loop callback
+    shows up in the event-loop-lag histogram (the probe's wakeup was
+    delayed behind it)."""
+    seed_index(srv)
+    time.sleep(0.3)  # let the probe tick a few times
+    srv.http._loop.call_soon_threadsafe(time.sleep, 0.4)
+    time.sleep(1.0)
+    sat = call(srv, "GET", "/debug/saturation?window=30")
+    assert sat["eventLoop"]["samples"] > 0
+    assert sat["eventLoop"]["lagMaxMs"] >= 200.0
+    hist = srv.stats.histogram("eventloop_lag_seconds")
+    assert hist is not None and hist.count > 0
+    # the GIL probe thread is live and reporting
+    assert sat["gil"]["samples"] > 0
+    assert any(t.name == "gil-probe" for t in threading.enumerate())
+
+
+def test_saturation_plane_off_is_inert(tmp_path):
+    s = make_server(
+        tmp_path, profiler_enabled=False, saturation_probes_enabled=False
+    )
+    try:
+        seed_index(s)
+        time.sleep(0.4)
+        names = {t.name for t in threading.enumerate()}
+        assert "profiler" not in names and "gil-probe" not in names
+        prof = call(s, "GET", "/debug/profile?format=segments")
+        assert prof["enabled"] is False and prof["running"] is False
+        sat = call(s, "GET", "/debug/saturation")
+        assert sat["enabled"] is False
+        assert sat["eventLoop"]["samples"] == 0
+        assert sat["gil"]["samples"] == 0
+        counters = s.stats.expvar()["counters"]
+        assert "profiler_samples_total" not in counters
+        with pytest.raises(urllib.error.HTTPError) as e:
+            call(s, "GET", "/debug/profile")
+        assert e.value.code == 404
+        # the /debug/ index reflects the live state: doctor must not
+        # exit non-zero on a healthy node whose profiler is simply off
+        idx = call(s, "GET", "/debug/")
+        prof_entry = next(
+            e for e in idx["endpoints"] if e["path"] == "/debug/profile"
+        )
+        assert prof_entry["doctor"] is None
+        out = tmp_path / "off-bundle.json"
+        rc = cli.main(
+            ["doctor", "--host", f"127.0.0.1:{s.port}", "--out", str(out)]
+        )
+        assert rc == 0
+        assert json.loads(out.read_text())["doctorErrors"] == 0
+    finally:
+        s.close()
+
+
+# ----------------------------------------------------------- HTTP surface
+def test_debug_profile_routes(srv):
+    seed_index(srv)
+    time.sleep(0.3)
+    folded = call(srv, "GET", "/debug/profile", raw=True).decode()
+    assert folded.startswith("#") and "samples" in folded.splitlines()[0]
+    ss = call(srv, "GET", "/debug/profile?format=speedscope&seconds=60")
+    assert ss["profiles"][0]["type"] == "sampled"
+    segs = call(srv, "GET", "/debug/profile?format=segments")
+    assert segs["enabled"] is True and segs["running"] is True
+    assert segs["segments"][-1]["id"] == segs["currentSegment"]
+    # subsystem attribution: serving threads appear by name
+    assert "http-" in folded or "MainThread" in folded
+
+
+def test_debug_index_lists_every_debug_route(srv):
+    from pilosa_tpu.server.http import _ROUTES
+
+    idx = call(srv, "GET", "/debug/")
+    listed = {e["path"] for e in idx["endpoints"]}
+    assert all(d["description"] for d in idx["endpoints"])
+    # every GET /debug route is listed (the directory may not lie by
+    # omission), and everything listed resolves to a real route
+    for method, pattern, _name in _ROUTES:
+        if method == "GET" and pattern.pattern.startswith("^/debug"):
+            assert any(pattern.match(p) for p in listed), pattern.pattern
+    for p in listed:
+        assert any(
+            m == "GET" and pat.match(p) for m, pat, _ in _ROUTES
+        ), f"{p} listed but unroutable"
+
+
+def test_flightrec_entry_links_profiler_segment(srv):
+    """Satellite: a retained query records the profiler segments
+    overlapping its wall-clock window."""
+    seed_index(srv)
+    # an errored query always retains, no latency engineering needed
+    with pytest.raises(urllib.error.HTTPError):
+        call(srv, "POST", "/index/i/query", b"Count(Row(nosuch=1))")
+    frec = call(srv, "GET", "/debug/flightrec")
+    assert frec["entries"], "errored query was not retained"
+    trace_id = frec["entries"][0]["traceId"]
+    entry = call(srv, "GET", f"/debug/flightrec?trace_id={trace_id}")
+    segs = entry.get("profilerSegments")
+    assert isinstance(segs, list) and segs
+    assert srv.profiler.current_segment_id in segs
+    # and the linked segment is fetchable
+    call(srv, "GET", f"/debug/profile?segment={segs[0]}", raw=True)
+
+
+def test_debug_resources_ledger(srv):
+    seed_index(srv)
+    res = call(srv, "GET", "/debug/resources")
+    subs = res["subsystems"]
+    for required in (
+        "deviceResidency",
+        "walOpsLog",
+        "compaction",
+        "flightrecRing",
+        "workloadCaptureRing",
+        "tracerRing",
+        "connections",
+        "workers.query",
+    ):
+        assert required in subs, required
+    for name, row in subs.items():
+        assert {"used", "limit", "unit", "pressure"} <= set(row), name
+        if row["pressure"] is not None:
+            assert row["pressure"] >= 0.0, name
+    # the budget reads None until a device-routed query resolved it —
+    # the ledger must not force resolution (a jax backend init) from a
+    # control-plane scrape
+    dr_limit = subs["deviceResidency"]["limit"]
+    assert dr_limit is None or dr_limit > 0
+    # the write above left ops-log bytes pending (WAL debt is measured)
+    assert subs["walOpsLog"]["used"] > 0
+    assert subs["walOpsLog"]["pendingOps"] > 0
+    gauges = srv.stats.expvar()["gauges"]
+    assert any(k.startswith("resource_pressure") for k in gauges)
+    assert any(k.startswith("resource_bytes") for k in gauges)
+    assert "snapshotMonotonicS" in res and "generatedAt" in res
+
+
+def test_wal_ledger_drops_after_snapshot(tmp_path):
+    from pilosa_tpu.core.fragment import Fragment
+
+    frag = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    frag.open()
+    for col in range(8):
+        frag.set_bit(1, col)
+    assert frag.ops_bytes > 0 and frag.op_n == 8
+    frag.snapshot()
+    assert frag.ops_bytes == 0 and frag.op_n == 0
+    # recovery restores the byte count from disk
+    frag.set_bit(2, 1)
+    persisted = frag.ops_bytes
+    assert persisted > 0
+    frag2 = Fragment(str(tmp_path / "frag"), "i", "f", "standard", 0)
+    frag2.open()
+    assert frag2.ops_bytes == persisted
+
+
+def test_background_threads_are_named(srv):
+    names = {t.name for t in threading.enumerate()}
+    for expected in ("http-eventloop", "profiler", "gil-probe"):
+        assert expected in names, (expected, sorted(names))
+
+
+# ----------------------------------------------------------------- doctor
+def test_doctor_bundle(srv, tmp_path, capsys):
+    seed_index(srv)
+    out = tmp_path / "bundle.json"
+    rc = cli.main(
+        ["doctor", "--host", f"127.0.0.1:{srv.port}", "--out", str(out)]
+    )
+    assert rc == 0
+    bundle = json.loads(out.read_text())
+    assert bundle["doctorErrors"] == 0
+    eps = bundle["endpoints"]
+    for path in (
+        "/status",
+        "/metrics",
+        "/debug/vars",
+        "/debug/saturation",
+        "/debug/resources",
+        "/debug/profile?format=speedscope",
+        "/debug/flightrec",
+    ):
+        assert path in eps, sorted(eps)
+    assert "pilosa_tpu_http_requests" in eps["/metrics"]["text"]
+    # Content-Type sniffing: the profile was fetched as speedscope and
+    # must land parsed, not as a text blob
+    assert "profiles" in eps["/debug/profile?format=speedscope"]
+    assert eps["/debug/saturation"]["binding"] is not None
+    listed = {e["path"] for e in bundle["debugIndex"]["endpoints"]}
+    assert "/debug/profile" in listed
