@@ -19,10 +19,12 @@ analyze:
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
 
-# mixed ingest+read row + restart-to-serving row (docs/durability.md);
+# mixed ingest+read row, the wire-speed sustained bulk-lane row
+# (docs/ingest.md — exits non-zero below 10 M set-bits/s through the
+# loader), and the restart-to-serving rows (docs/durability.md); also
 # exits non-zero when mixed read p95 breaks the 2x read-only gate
 bench-ingest:
-	PILOSA_BENCH_ALL_CHILD=ingest python bench_all.py
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=ingest python bench_all.py | tee BENCH_INGEST_r14.json
 
 # tiered compressed residency row (docs/device-residency.md): an index
 # whose uncompressed stack is >=4x the device budget, hot-set QPS vs the

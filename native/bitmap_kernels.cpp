@@ -184,6 +184,27 @@ void u32_stack_fill(const uint32_t** srcs, const int64_t* src_rows,
     }
 }
 
+// Bucket the low 16 bits of combined (group << 16 | low) keys by their
+// group in one counting pass: histogram + offsets + direct scatter of
+// the truncated lows, with no argsort permutation materialized — the
+// bulk container builder's grouping primitive. ``counts`` must hold
+// max_gk + 1 zeroed slots; on return counts[g] is group g's EXCLUSIVE
+// end offset in lows_out (same convention as u64_counting_argsort).
+void u64_bucket_lows(const uint64_t* keys, int64_t n, int64_t max_gk,
+                     int64_t* counts, uint16_t* lows_out) {
+    for (int64_t i = 0; i < n; ++i) ++counts[keys[i] >> 16];
+    int64_t acc = 0;
+    for (int64_t b = 0; b <= max_gk; ++b) {
+        int64_t c = counts[b];
+        counts[b] = acc;
+        acc += c;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+        const uint64_t k = keys[i];
+        lows_out[counts[k >> 16]++] = (uint16_t)k;
+    }
+}
+
 // Stable counting argsort for small integer keys (max_key bounded):
 // O(n + max_key). ``counts`` must hold max_key + 1 zeroed slots.
 void u64_counting_argsort(const uint64_t* keys, int64_t n, int64_t max_key,

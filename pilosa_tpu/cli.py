@@ -141,8 +141,54 @@ def cmd_server(args) -> int:
 
 
 def cmd_import(args) -> int:
-    """CSV import: rows of `rowID,columnID[,timestamp]` or, with
-    --field-type int, `columnID,value` (reference: ctl/import.go)."""
+    """Bulk import (reference: ctl/import.go). Two lanes:
+
+    - default: CSV rows of `rowID,columnID[,timestamp]` (or, with
+      --values, `columnID,value`) POSTed as JSON batches to /import
+      (/import-value) — key translation and time views supported;
+    - ``--roaring``: the wire-speed bulk lane (docs/ingest.md) — CSV or
+      JSONL/NDJSON records vectorized into per-shard serialized roaring
+      frames and streamed to /import-roaring with bounded pipelining
+      and 429/Retry-After backoff. IDs only (roaring frames carry no
+      keys), standard view, set fields.
+    """
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+    base = f"{root}/index/{args.index}/field/{args.field}"
+    if args.roaring:
+        from pilosa_tpu import loader
+
+        if args.values:
+            print("--roaring is a bit lane; use the default lane for "
+                  "--values (BSI) imports", file=sys.stderr)
+            return 2
+        fmt = args.format or (
+            "jsonl" if args.path == "-" else loader.detect_format(args.path)
+        )
+        f = sys.stdin if args.path == "-" else open(args.path)
+        with f:
+            rows, cols = loader.parse_records(f, fmt)
+        if args.create:
+            _http("POST", f"{root}/index/{args.index}", b"{}")
+            _http("POST", base, json.dumps({}).encode())
+        stats = loader.bulk_load(
+            root,
+            args.index,
+            args.field,
+            rows,
+            cols,
+            pipeline=args.pipeline,
+            batch_bits=args.batch_size,
+            ssl_context=_SSL_CTX,
+        )
+        print(
+            f"imported {stats['bits']} bits into "
+            f"{args.index}/{args.field} via {stats['posts']} roaring "
+            f"frames in {stats['seconds']}s "
+            f"({stats['mbitSetPerS']} Mbit/s, "
+            f"{stats['backoffs429']} backoffs)"
+        )
+        return 0
     rows, cols, timestamps, values = [], [], [], []
     f = sys.stdin if args.path == "-" else open(args.path)
     with f:
@@ -159,9 +205,6 @@ def cmd_import(args) -> int:
                 cols.append(int(parts[1]))
                 if len(parts) > 2:
                     timestamps.append(parts[2])
-    _apply_skip_verify(args)
-    root = _base_uri(args.host)
-    base = f"{root}/index/{args.index}/field/{args.field}"
     if args.create:
         _http("POST", f"{root}/index/{args.index}", b"{}")
         opts = {"options": {"type": "int"}} if args.values else {}
@@ -457,8 +500,8 @@ def main(argv: list[str] | None = None) -> int:
     )
     s.set_defaults(fn=cmd_server)
 
-    s = sub.add_parser("import", help="CSV import")
-    s.add_argument("path", help="CSV file or - for stdin")
+    s = sub.add_parser("import", help="CSV/JSONL bulk import")
+    s.add_argument("path", help="input file or - for stdin")
     s.add_argument("--host", default="127.0.0.1:10101",
                    help="host:port or https://host:port for TLS servers")
     s.add_argument("--tls-skip-verify", action="store_true",
@@ -467,7 +510,19 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("-f", "--field", required=True)
     s.add_argument("--create", action="store_true", help="create index/field first")
     s.add_argument("--values", action="store_true", help="columnID,value rows (int field)")
-    s.add_argument("--batch-size", type=int, default=100_000)
+    s.add_argument("--batch-size", type=int, default=100_000,
+                   help="records per POST (default lane) / positions per "
+                        "roaring frame (--roaring)")
+    s.add_argument("--roaring", action="store_true",
+                   help="wire-speed bulk lane: build per-shard roaring "
+                        "frames client-side and stream them to "
+                        "/import-roaring (docs/ingest.md)")
+    s.add_argument("--format", choices=["csv", "jsonl", "ndjson"],
+                   default=None,
+                   help="input record format for --roaring (default: by "
+                        "file extension; stdin defaults to jsonl)")
+    s.add_argument("--pipeline", type=int, default=4,
+                   help="concurrent in-flight frames for --roaring")
     s.set_defaults(fn=cmd_import)
 
     s = sub.add_parser("export", help="CSV export")

@@ -49,6 +49,10 @@ _LOG = Logger()  # stderr sink; recovery events must be loud
 # env-overridable so benches/chaos runs can keep the background
 # compactor hot without minutes of ingest per fold
 MAX_OP_N = int(os.environ.get("PILOSA_TPU_MAX_OP_N", "2000"))
+# ops-log BYTE debt that triggers a fold regardless of op count: bulk-
+# ingest union records carry whole roaring frames, so a log can grow
+# replay-expensive long before op_n trips the count threshold
+MAX_OP_BYTES = int(os.environ.get("PILOSA_TPU_MAX_OP_BYTES", str(8 << 20)))
 ROWS_PER_BLOCK = 100  # anti-entropy block granularity (reference: HashBlockSize)
 MIN_PADDED_ROWS = 8  # sublane tile for int32
 
@@ -84,6 +88,13 @@ class Fragment:
         # ledger aggregates (replay time after a crash grows with it)
         self.ops_bytes = 0
         self.max_op_n = MAX_OP_N
+        self.max_op_bytes = MAX_OP_BYTES
+        # serialized size of the last written snapshot: the byte-debt
+        # fold trigger scales with it (fold when the log outgrows the
+        # snapshot) so sustained bulk ingest pays O(1) amortized write
+        # amplification — a FIXED byte trigger re-serializes an ever-
+        # growing fragment at an ever-shorter interval
+        self.snapshot_bytes = 0
         # contention-counted (docs/profiling.md): every fragment's lock
         # folds into the "fragment" family in /debug/saturation
         self._lock = saturation.ContendedLock("fragment", reentrant=True)
@@ -201,6 +212,7 @@ class Fragment:
         res = roaring.replay_ops_checked(self.bitmap, data[consumed:])
         self.op_n = res.n_ops
         self.ops_bytes = res.good_bytes
+        self.snapshot_bytes = consumed
         good_end = consumed + res.good_bytes
         if res.corrupt:
             rec["corrupt"] = True
@@ -242,7 +254,41 @@ class Fragment:
         durable.append_wal(self.path, framed)
         self.op_n += 1
         self.ops_bytes += len(framed)
-        if self.op_n > self.max_op_n:
+        self._maybe_fold()
+
+    def _append_union_op(self, frame: bytes) -> None:
+        """Ops-log append of one whole roaring frame (the bulk-ingest
+        adopt record): same gating/durability rules as ``_append_op``,
+        but the payload is the incoming serialized bitmap rather than a
+        value vector — an import-roaring post pays ONE crc32-framed WAL
+        append (group-fsynced at the ack barrier) instead of the full
+        snapshot rewrite it used to pay, and the background Compactor
+        folds the accumulated frames off the write path."""
+        if self.path is None or not self._opened or self._dropped:
+            return
+        framed = roaring.append_union_op(frame)
+        durable.append_wal(self.path, framed)
+        self.op_n += 1
+        self.ops_bytes += len(framed)
+        self._maybe_fold()
+
+    # byte-debt fold trigger = max(max_op_bytes, FACTOR × snapshot):
+    # scaling with the live snapshot bounds write amplification to
+    # ~1 + 1/FACTOR and keeps the compactor's GIL-heavy whole-fragment
+    # serialize at a low duty cycle under sustained bulk ingest (at
+    # FACTOR=1 the fold ran after nearly every frame on a grown
+    # fragment, stealing the serving core); crash replay stays within
+    # ~FACTOR × the snapshot parse — union-frame replay is a
+    # deserialize + container OR pass, far cheaper than the fold
+    FOLD_BYTES_FACTOR = 4
+
+    def _maybe_fold(self) -> None:
+        # two debt axes, either trips the fold: record count (replay op
+        # overhead) and bytes (replay parse volume — union frames can
+        # blow past the byte axis in a handful of records)
+        if self.op_n > self.max_op_n or self.ops_bytes > max(
+            self.max_op_bytes, self.FOLD_BYTES_FACTOR * self.snapshot_bytes
+        ):
             if self._compactor is not None:
                 self._compactor.request(self, reason="threshold")
             else:
@@ -271,6 +317,7 @@ class Fragment:
         durable.atomic_write_file(
             self.path, data, tmp_suffix=".snapshotting", op="snapshot-write"
         )
+        self.snapshot_bytes = len(data)
         self._snap_gen += 1
 
     def drop(self) -> None:
@@ -350,6 +397,7 @@ class Fragment:
             if tail:
                 durable.append_file(tmp, tail, op="snapshot-write")
             durable.replace_durable(tmp, self.path)
+            self.snapshot_bytes = len(data)
             self._snap_gen += 1
             self.op_n -= ops_at_clone
             self.ops_bytes = max(0, self.ops_bytes - ops_bytes_at_clone)
@@ -553,8 +601,14 @@ class Fragment:
 
     def import_roaring(self, data: bytes) -> "roaring.Bitmap":
         """Union a serialized roaring bitmap of fragment-relative positions
-        straight into storage (reference: fragment.importRoaring fast path);
-        snapshots rather than logging the (potentially huge) delta.
+        straight into storage (reference: fragment.importRoaring fast
+        path). Durability is ONE crc32-framed union-op WAL append of the
+        incoming frame (group-fsynced at the caller's ack barrier) — NOT
+        a full snapshot rewrite per post: the pre-r14 inline snapshot
+        paid serialize+fsync+rename of the whole merged fragment inside
+        the lock on every import, which is exactly what capped sustained
+        ingest at demo speed. The background Compactor folds the
+        accumulated frames off the write path (``_maybe_fold``).
 
         Returns the INCOMING bitmap (the delta, pre-union) so callers
         that derive follow-up work from the import — existence-field
@@ -572,27 +626,40 @@ class Fragment:
                 self.bitmap = incoming
             else:
                 self.bitmap = self.bitmap | incoming
-            self.snapshot()
+            self._append_union_op(data)
             self._mark_all_dirty()
             return incoming
 
     def union_positions(self, positions: np.ndarray) -> None:
         """Bulk-OR fragment-relative positions: the import_roaring merge
         without the wire codec — build the delta's containers vectorized,
-        union (or adopt on a fresh fragment), snapshot once. O(delta);
-        for deltas past the ops-log threshold this beats the per-op
-        bit-list path by an order of magnitude."""
+        then ``union_bitmap``. O(delta); for deltas past the ops-log
+        threshold this beats the per-op bit-list path by an order of
+        magnitude, and the logged frame is far smaller than an OP_ADD
+        record's 8 bytes/bit for dense deltas."""
         positions = np.asarray(positions, dtype=np.uint64)
         if positions.size == 0:
             return
+        incoming = roaring.Bitmap()
+        incoming.add_many(positions)
+        self.union_bitmap(incoming)
+
+    def union_bitmap(self, incoming: "roaring.Bitmap") -> None:
+        """Union a PRE-BUILT delta bitmap into storage (the existence-
+        marking fast path: the adopt delta's column set is folded
+        container-wise, never re-sorted — docs/ingest.md). Durability is
+        one compressed union-frame WAL append, like import_roaring. The
+        caller must hand over ownership: containers may be adopted by
+        reference."""
+        if not incoming._containers:
+            return
         with self._lock:
-            incoming = roaring.Bitmap()
-            incoming.add_many(positions)
+            frame = roaring.serialize(incoming)
             if not self.bitmap._containers:
                 self.bitmap = incoming
             else:
                 self.bitmap = self.bitmap | incoming
-            self.snapshot()
+            self._append_union_op(frame)
             self._mark_all_dirty()
 
     DIRTY_HISTORY_MAX = 4096

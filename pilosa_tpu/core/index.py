@@ -146,6 +146,29 @@ class Index:
             # existence row is 0: position == in-shard column offset
             frag.union_positions(cols[shards == sh] % np.uint64(SHARD_WIDTH))
 
+    def mark_shard_columns(self, shard: int, col_bitmap) -> None:
+        """Existence marking for a single-shard bulk adopt: the caller
+        already holds the delta's shard-relative column set as a Bitmap
+        (folded container-wise off the adopt delta — see
+        roaring/build.py:fold_to_columns), so this unions it straight
+        into the existence fragment with one WAL append. Row 0 of
+        ``_exists`` puts position == column offset, so the folded bitmap
+        IS the position bitmap."""
+        ef = self.existence_field()
+        if ef is None or not col_bitmap._containers:
+            return
+        frag = ef.create_view_if_not_exists(
+            VIEW_STANDARD
+        ).create_fragment_if_not_exists(int(shard))
+        with frag._lock:
+            if frag.row_count(0) >= SHARD_WIDTH:
+                # every column of the shard is already marked: the union
+                # is a no-op and must not pay an O(delta) merge + WAL
+                # frame per post — sustained re-ingest into a warm shard
+                # hits this on every import
+                return
+            frag.union_bitmap(col_bitmap)
+
     def available_shards(self) -> set[int]:
         shards: set[int] = set()
         for f in self.fields.values():

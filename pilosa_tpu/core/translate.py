@@ -81,9 +81,11 @@ class TranslateStore:
                     )
                     durable.truncate_file(self.path, good)
             # retained append handle (allocation rate makes open-per-
-            # write measurable here); durability bookkeeping happens at
-            # each flushed write via durable.wal_written
-            self._file = durable.open_wal(self.path, "a")
+            # write measurable here); binary mode so the batched append
+            # helper (durable.wal_write) can apply torn-write fault caps
+            # on raw bytes. Durability bookkeeping happens once per
+            # flushed BATCH via durable.wal_written.
+            self._file = durable.open_wal(self.path, "ab")
 
     def close(self) -> None:
         with self._lock:
@@ -117,22 +119,31 @@ class TranslateStore:
     def translate_key(self, key: str, create: bool = True) -> int | None:
         """key → ID, allocating when ``create`` (reference:
         TranslateStore.TranslateColumnsToUint64)."""
-        with self._lock:
-            id_ = self._by_key.get(key)
-            if id_ is not None:
-                return id_
-            if not create:
-                return None
-            id_ = self._next_id
-            self._apply(key, id_)
-            if self._file:
-                self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
-                self._file.flush()
-                durable.wal_written(self.path, self._file.fileno())
-            return id_
+        return self.translate_keys([key], create=create)[0]
 
     def translate_keys(self, keys: list[str], create: bool = True) -> list[int | None]:
-        return [self.translate_key(k, create) for k in keys]
+        """Batched key → ID translation: one lock acquisition, one WAL
+        append (all new bindings joined into a single buffer), one flush
+        and one group-commit mark for the WHOLE batch — the per-key
+        write/flush/fsync-mark loop made keyed imports pay a durability
+        round per rowKey/columnKey (docs/ingest.md). The API façade's
+        ``ack_barrier`` after the request is the one fsync point either
+        way."""
+        with self._lock:
+            out: list[int | None] = []
+            new_lines: list[str] = []
+            for key in keys:
+                id_ = self._by_key.get(key)
+                if id_ is None and create:
+                    id_ = self._next_id
+                    self._apply(key, id_)
+                    new_lines.append(json.dumps({"k": key, "id": id_}))
+                out.append(id_)
+            if new_lines and self._file:
+                durable.wal_write(
+                    self._file, "\n".join(new_lines) + "\n", self.path
+                )
+            return out
 
     def translate_id(self, id_: int) -> str | None:
         with self._lock:
@@ -253,12 +264,16 @@ class TranslateStore:
         """
         dropped: list[tuple[str, int]] = []
         with self._lock:
+            new_lines: list[str] = []
             for key, id_ in entries:
-                if self._apply_displacing(key, id_, dropped) and self._file:
-                    self._file.write(json.dumps({"k": key, "id": id_}) + "\n")
-            if self._file:
-                self._file.flush()
-                durable.wal_written(self.path, self._file.fileno())
+                if self._apply_displacing(key, id_, dropped):
+                    new_lines.append(json.dumps({"k": key, "id": id_}))
+            if new_lines and self._file:
+                # one batched append + one group-commit mark, like
+                # translate_keys — replication apply is the same lane
+                durable.wal_write(
+                    self._file, "\n".join(new_lines) + "\n", self.path
+                )
         return dropped
 
     def _apply_displacing(

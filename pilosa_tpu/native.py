@@ -102,6 +102,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
             c_u64p, ctypes.c_int64, ctypes.c_int64, c_i64p, c_i64p,
         ]
         lib.u64_counting_argsort.restype = None
+        lib.u64_bucket_lows.argtypes = [
+            c_u64p, ctypes.c_int64, ctypes.c_int64, c_i64p,
+            ctypes.POINTER(ctypes.c_uint16),
+        ]
+        lib.u64_bucket_lows.restype = None
         lib.u32_stack_fill.argtypes = [
             ctypes.POINTER(ctypes.c_void_p), c_i64p, ctypes.c_int64,
             ctypes.c_int64, c_u32p, ctypes.c_int64, ctypes.c_int64,
@@ -289,6 +294,28 @@ def counting_argsort(keys: np.ndarray) -> np.ndarray:
         _ptr(counts, ctypes.c_int64), _ptr(order, ctypes.c_int64),
     )
     return order
+
+
+def bucket_lows(
+    keys: np.ndarray, max_gk: int
+) -> tuple[np.ndarray, np.ndarray] | None:
+    """Group combined ``(gk << 16 | low)`` keys by gk in ONE native
+    counting pass, returning (lows_sorted_by_group uint16, per-group
+    histogram int64) — the bulk container builder's grouping step with
+    no argsort permutation, no gather, no separate bincount. None when
+    the native library is unavailable (caller falls back)."""
+    lib = _load()
+    if lib is None:
+        return None
+    k = np.ascontiguousarray(keys, dtype=np.uint64)
+    counts = np.zeros(max_gk + 1, dtype=np.int64)
+    lows = np.empty(k.size, dtype=np.uint16)
+    lib.u64_bucket_lows(
+        _ptr(k, ctypes.c_uint64), k.size, max_gk,
+        _ptr(counts, ctypes.c_int64),
+        _ptr(lows, ctypes.c_uint16),
+    )
+    return lows, np.diff(counts, prepend=0)
 
 
 def uniq_sorted(arr: np.ndarray):

@@ -388,10 +388,15 @@ class InternalClient:
     def import_roaring(
         self, uri: str, index: str, field: str, view: str, shard: int, data: bytes
     ) -> None:
+        """Deliver one serialized roaring frame to ONE node (the
+        internal node-local route): the replica fan-out and the resize
+        handoff both stream the SAME frame bytes here per owner — the
+        receiver applies locally, never re-fans-out (the public
+        import-roaring route is the one that fans out)."""
         self._request(
             "POST",
             uri,
-            f"/index/{index}/field/{field}/import-roaring/{shard}?view={view}",
+            f"/internal/import-roaring/{index}/{field}/{shard}?view={view}",
             data,
         )
 

@@ -411,6 +411,7 @@ class Cluster:
         self.server.http.trace_fetch = self._fetch_cluster_trace
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
+        self.server.http.roaring_router = self.import_roaring_router
         self.server.http.translate_router = self._route_translate_keys
         self.server.http.broadcast_schema = self.broadcast_schema
         self.server.http.broadcast_deletion = self.broadcast_deletion
@@ -2089,6 +2090,77 @@ class Cluster:
                     entries.setdefault(uri, []).append(sh)
             self._announce_shards(index, entries)
 
+    def import_roaring_router(
+        self, index: str, field: str, shard: int, data: bytes, view: str
+    ) -> int:
+        """Clustered bulk-lane import (docs/ingest.md): the incoming
+        serialized roaring frame is streamed VERBATIM to every alive
+        owner of the shard — the frame the client built is the frame
+        every replica adopts; no per-replica re-serialization, no
+        per-bit path anywhere. Remote legs go concurrently through the
+        single-shot (never-retried) write RPC and each replica answers
+        only after its own WAL append + ack barrier, so the client's
+        acknowledgement is covered by every replica's durability barrier
+        (the PR 8 round-2 rule). Returns the adopted delta bit count
+        when this node applied locally (ingest metering)."""
+        self._check_ready()
+        api = self.server.api
+        if self.server.holder.index(index) is None:
+            raise ValueError(f"index {index!r} not found")
+        sh = int(shard)
+        owners = self.shard_nodes(index, sh)
+        remote = [
+            o
+            for o in owners
+            if o.id != self.me.id and self._probe_alive(o)
+        ]
+        local = any(o.id == self.me.id for o in owners)
+        futs = []
+        if remote:
+            pool = self._import_pool()
+
+            def push(node):
+                t0 = time.perf_counter()
+                with GLOBAL_TRACER.span(
+                    "cluster.import_roaring", node=node.id, shards=1
+                ):
+                    self.client.import_roaring(
+                        node.uri, index, field, view, sh, data
+                    )
+                if self.server.stats is not None:
+                    self.server.stats.timing(
+                        "fanout_rpc_seconds",
+                        time.perf_counter() - t0,
+                        tags={"node": node.id},
+                    )
+
+            futs = [(o, pool.submit(push, o)) for o in remote]
+        bits = 0
+        applied = 0
+        took_write: list[str] = []
+        if local:
+            bits = api.import_roaring(index, field, sh, data, view=view)
+            applied += 1
+            took_write.append(self.me.uri)
+        for node, fut in futs:
+            fut.result()  # a failed replica leg fails the import loudly
+            applied += 1
+            took_write.append(node.uri)
+        if applied == 0:
+            raise ShardUnavailableError(
+                f"no alive owner for shard {sh}; import rejected"
+            )
+        with self._shard_cache_lock:
+            known = self._known_shards.setdefault(index, set())
+            new_shard = sh not in known
+            known.add(sh)
+        if new_shard:
+            # synchronous announce BEFORE the ack, naming only the
+            # owners that actually took the frame (same read-your-writes
+            # rule as import_router)
+            self._announce_shards(index, {u: [sh] for u in took_write})
+        return bits
+
     # ---------------------------------------------------------- translation
     def _route_translate_keys(
         self, index: str, field: str | None, keys: list[str], create: bool
@@ -2650,6 +2722,12 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/import-value/([^/]+)/([^/]+)$"),
             ): self._h_import_values,
+            (
+                "POST",
+                re.compile(
+                    r"^/internal/import-roaring/([^/]+)/([^/]+)/(\d+)$"
+                ),
+            ): self._h_import_roaring,
             ("POST", re.compile(r"^/internal/attrs/set$")): self._h_attr_set,
             ("GET", re.compile(r"^/internal/attrs/blocks$")): self._h_attr_blocks,
             (
@@ -2975,6 +3053,25 @@ class Cluster:
             index, field, self._import_body(handler), values=True
         )
         handler._json({"success": True, "appliedBy": applied_by})
+
+    def _h_import_roaring(
+        self, handler, index: str, field: str, shard: str
+    ) -> None:
+        # node-local bulk-lane apply (no re-fan-out — the coordinator's
+        # roaring_router already addressed every owner): adopt the frame
+        # via one WAL append, barrier inside api.import_roaring, THEN
+        # ack — the coordinator's client acknowledgement is backed by
+        # this replica's durability barrier. Not device-probe gated for
+        # the same reason as _h_import_bits (numpy/roaring only).
+        data = handler._body()
+        view = handler.query_params.get("view", ["standard"])[0] or "standard"
+        bits = self.server.api.import_roaring(
+            index, field, int(shard), data, view=view
+        )
+        meter = getattr(self.server.http, "ingest_meter", None)
+        if meter is not None:
+            meter.record(len(data), bits)
+        handler._json({"success": True, "bits": bits})
 
     def _apply_or_reforward_import(
         self, index: str, field: str, payload: dict, values: bool

@@ -6,6 +6,12 @@ format, import/export interchange, CPU oracle, and host baseline.
 """
 
 from pilosa_tpu.roaring.bitmap import Bitmap
+from pilosa_tpu.roaring.build import (
+    bitmap_from_positions,
+    payload_from_positions,
+    shard_payloads,
+    split_by_shard,
+)
 from pilosa_tpu.roaring.containers import Container
 from pilosa_tpu.roaring.pack import (
     pack_positions,
@@ -16,8 +22,10 @@ from pilosa_tpu.roaring.pack import (
 from pilosa_tpu.roaring.serialize import (
     OP_ADD,
     OP_REMOVE,
+    OP_UNION,
     ReplayResult,
     append_op,
+    append_union_op,
     deserialize,
     replay_ops,
     replay_ops_checked,
@@ -36,9 +44,15 @@ __all__ = [
     "serialize_official",
     "deserialize",
     "append_op",
+    "append_union_op",
     "replay_ops",
     "replay_ops_checked",
     "ReplayResult",
     "OP_ADD",
     "OP_REMOVE",
+    "OP_UNION",
+    "bitmap_from_positions",
+    "payload_from_positions",
+    "shard_payloads",
+    "split_by_shard",
 ]

@@ -74,16 +74,22 @@ class Bitmap:
                 self._containers[key] = nc
         return changed
 
-    def add_many(self, values: np.ndarray) -> None:
+    def add_many(self, values: np.ndarray, presorted: bool = False) -> None:
         """Vectorised bulk add. Absent/array-container targets (the common
         case) are handled by ONE globally-sorted merge of the incoming
         values with every touched array container's contents — per-
         container numpy (union1d per key) was the import bottleneck at
         ~64k touched containers per batch. Bitmap/run targets get a
-        vectorized word-OR each (few — only containers past 4096 bits)."""
+        vectorized word-OR each (few — only containers past 4096 bits).
+
+        ``presorted=True`` asserts ``values`` is already sorted unique
+        and skips the radix pass — the bulk-ingest builders sort ONCE on
+        a combined (shard, position) key and must not re-sort every
+        shard slice (docs/ingest.md)."""
         if values.size == 0:
             return
-        values = native.sort_unique_u64(values)
+        if not presorted:
+            values = native.sort_unique_u64(values)
         keys = (values >> _KEY_SHIFT).astype(np.int64)
         uniq_keys, starts = _uniq_sorted(keys)
         bounds = np.append(starts, keys.size)
@@ -444,6 +450,17 @@ class Bitmap:
                 else:
                     oc[key] = ct.Container(t_array, chunk)
         return out
+
+    def union_in_place(self, other: "Bitmap") -> None:
+        """Merge ``other`` into this bitmap. An empty receiver ADOPTS
+        the other's container dict outright (the dominant fresh-adopt
+        replay/import case — zero copies); payload immutability (every
+        mutator replaces, never edits, a container) makes the sharing
+        safe exactly as in ``union``."""
+        if not self._containers:
+            self._containers = other._containers
+        elif other._containers:
+            self._containers = (self | other)._containers
 
     def difference(self, other: "Bitmap") -> "Bitmap":
         return self._zipped(other, self._containers.keys(), ct.container_andnot)

@@ -42,6 +42,13 @@ distinguishes a torn tail (record runs past EOF — truncate, the write
 never finished) from in-place corruption (full-length record, checksum
 mismatch — report with offset, then truncate conservatively). opcode
 1=add, 2=remove either way.
+
+Opcode 3 (union, v2-only) is the bulk-ingest record: its body is a
+whole serialized roaring frame (snapshot layout, optionally followed by
+its own add/remove op records) that replay UNIONS into the bitmap.
+``count`` holds the body's BYTE length for this opcode — the payload is
+a container stream, not a u64 vector. One import-roaring post appends
+one of these instead of rewriting the whole snapshot (docs/ingest.md).
 """
 
 from __future__ import annotations
@@ -69,6 +76,7 @@ OP_MAGIC = 0xF1  # v1 record: no checksum (read-compat only)
 OP_MAGIC2 = 0xF2  # v2 record: crc32-framed (what append_op writes)
 OP_ADD = 1
 OP_REMOVE = 2
+OP_UNION = 3  # body = serialized roaring frame, count = byte length
 
 _HEADER = struct.Struct("<HHI")
 _META = struct.Struct("<QHHI")
@@ -393,6 +401,18 @@ def append_op(opcode: int, values: np.ndarray) -> bytes:
     return _OP2_HEADER.pack(OP_MAGIC2, opcode, values.size, crc) + body
 
 
+def append_union_op(frame: bytes) -> bytes:
+    """Encode one UNION ops-log record (v2, crc32-framed): the body is a
+    whole serialized roaring frame adopted wholesale on replay. This is
+    the bulk-ingest record — one compressed frame per import post
+    instead of a full snapshot rewrite (8 bytes/bit for OP_ADD vs the
+    container stream's packed words/runs)."""
+    crc = zlib.crc32(frame, zlib.crc32(
+        _OP_HEADER.pack(OP_MAGIC2, OP_UNION, len(frame))
+    ))
+    return _OP2_HEADER.pack(OP_MAGIC2, OP_UNION, len(frame), crc) + frame
+
+
 @dataclass
 class ReplayResult:
     """Outcome of a checked ops-log replay.
@@ -426,7 +446,10 @@ def replay_ops_checked(bitmap: Bitmap, data: bytes) -> ReplayResult:
                 break  # torn mid-header
             _m, opcode, count, crc = _OP2_HEADER.unpack_from(data, pos)
             body_start = pos + _OP2_HEADER.size
-            body_end = body_start + count * 8
+            # UNION bodies are a serialized roaring frame: count is the
+            # byte length, not a u64 vector size
+            body_len = count if opcode == OP_UNION else count * 8
+            body_end = body_start + body_len
             if body_end > n:
                 break  # torn write
             body = data[body_start:body_end]
@@ -443,11 +466,20 @@ def replay_ops_checked(bitmap: Bitmap, data: bytes) -> ReplayResult:
                 break  # torn write
         else:
             break  # unrecognized tail byte: treat as torn
-        values = np.frombuffer(data, np.uint64, count, body_start)
         if opcode == OP_ADD:
-            bitmap.add_many(values)
+            bitmap.add_many(np.frombuffer(data, np.uint64, count, body_start))
         elif opcode == OP_REMOVE:
-            bitmap.remove_many(values)
+            bitmap.remove_many(np.frombuffer(data, np.uint64, count, body_start))
+        elif opcode == OP_UNION and magic == OP_MAGIC2:
+            # checksum already verified above: a malformed frame here is
+            # in-place corruption the crc missed only if the writer
+            # framed garbage — surface it as corruption, not a crash
+            try:
+                inc, c2 = deserialize(data[body_start:body_end])
+                replay_ops(inc, data[body_start + c2 : body_end])
+            except ValueError:
+                return ReplayResult(n_ops, pos, corrupt=True, corrupt_offset=pos)
+            bitmap.union_in_place(inc)
         else:
             break
         pos = body_end

@@ -368,26 +368,39 @@ class API:
         idx.mark_columns_exist(cols)
         durable.ack_barrier()  # acknowledged ⇒ on disk (docs/durability.md)
 
-    def import_roaring(self, index: str, field: str, shard: int, data: bytes, view: str = VIEW_STANDARD) -> None:
+    def import_roaring(self, index: str, field: str, shard: int, data: bytes, view: str = VIEW_STANDARD) -> int:
         """Direct roaring-bitmap union into a fragment (reference:
-        api.ImportRoaring fast path)."""
+        api.ImportRoaring fast path). The wire-speed bulk lane
+        (docs/ingest.md): the fragment adopts the incoming frame with
+        ONE crc32-framed WAL append, and the single ``ack_barrier``
+        below group-fsyncs it together with the existence-field appends
+        — fsyncs amortize across concurrent importers instead of a full
+        durable snapshot per post."""
         idx = self._index(index)
         f = self._field(idx, field)
         frag = f.create_view_if_not_exists(view).create_fragment_if_not_exists(shard)
         delta = frag.import_roaring(data)
         # existence marking from the DELTA (incoming positions), not the
         # merged fragment — a whole-fragment values() pass per import
-        # made repeated bulk loads O(fragment) each (measured 2026-07-31:
-        # the difference between 2.9 and >10 M set-bits/s through the API).
-        # values() under the fragment lock: on the fresh-adopt path the
-        # returned bitmap IS live storage, and a concurrent writer
-        # mutating its containers mid-iteration would throw (or tear)
+        # made repeated bulk loads O(fragment) each. Folded CONTAINER-
+        # wise (fold_to_columns: key arithmetic + OR chain), never a
+        # value-vector sort: the per-import existence sort was the next
+        # bottleneck once the adopt itself went to one WAL append.
+        # Under the fragment lock: on the fresh-adopt path ``delta`` IS
+        # live storage, and a concurrent writer mutating its containers
+        # mid-fold would tear it.
+        from pilosa_tpu.roaring.build import fold_to_columns
+
         with frag._lock:
-            delta_cols = delta.values() % np.uint64(SHARD_WIDTH)
-        idx.mark_columns_exist(delta_cols + np.uint64(shard * SHARD_WIDTH))
-        # the roaring import itself snapshots (atomic write, durable);
-        # the barrier covers the existence-field ops-log appends
+            bits = delta.count()
+            delta_cols = fold_to_columns(delta, SHARD_WIDTH)
+        idx.mark_shard_columns(shard, delta_cols)
+        # acknowledged ⇒ on disk: the barrier group-fsyncs the
+        # fragment's union-frame append AND the existence-field appends
+        # in one pass (docs/durability.md, docs/ingest.md)
         durable.ack_barrier()
+        # adopted bit count (the delta, deduplicated) — ingest metering
+        return int(bits)
 
     @staticmethod
     def _payload_size(payload: dict) -> int:

@@ -48,6 +48,10 @@ from pilosa_tpu.utils import StatsClient
 # combined request-line + headers byte cap (http.server's _MAXLINE era
 # limit); past it the client gets 431 and the connection closes
 MAX_HEADER_BYTES = 65536
+# asyncio stream high-water: sized so a multi-MiB import-roaring body
+# buffers in few loop wakeups instead of 64 KiB dribbles (see the
+# start_server call); per-connection memory stays bounded at 2x this
+STREAM_BUFFER_BYTES = 1 << 20
 
 # listen backlog: the kernel absorbs a connect burst while the loop
 # accepts; admission control (not the backlog) is the real limiter, so
@@ -97,7 +101,7 @@ class _ConnState:
     handles per request is measurable overhead on the c1 hot path, and
     DoS cuts don't need precision timing."""
 
-    __slots__ = ("writer", "phase", "since", "aborted")
+    __slots__ = ("writer", "phase", "since", "aborted", "readahead")
 
     IDLE = 0  # between requests (keep-alive)
     HEAD = 1  # reading request line + headers
@@ -113,6 +117,9 @@ class _ConnState:
         self.phase = _ConnState.IDLE
         self.since = time.monotonic()
         self.aborted = False
+        # bytes read past a head's CRLFCRLF (pipelined body prefix /
+        # next request) — consumed by the body read before the socket
+        self.readahead = b""
 
     def enter(self, phase: int) -> None:
         self.phase = phase
@@ -307,7 +314,14 @@ class EventHTTPServer(_ServerCore):
         server = await asyncio.start_server(
             self._handle_conn,
             sock=self.socket,
-            limit=MAX_HEADER_BYTES,
+            # stream buffer sized for BULK bodies, not heads: with the
+            # old 64 KiB limit a 2 MiB import-roaring frame drained in
+            # ~16-32 read() wakeups, each queued behind whatever GIL
+            # hold a numpy-crunching worker had in flight — measured
+            # ~100ms per body under sustained ingest. Heads keep the
+            # MAX_HEADER_BYTES cap via the explicit check in _read_head
+            # (LimitOverrunError at this limit stays the backstop).
+            limit=STREAM_BUFFER_BYTES,
             backlog=LISTEN_BACKLOG,
             **kwargs,
         )
@@ -525,21 +539,43 @@ class EventHTTPServer(_ServerCore):
         """Request head (request line + headers + CRLFCRLF), or None on
         clean EOF / a watchdog cut.  The idle reap and the slowloris
         timeout are enforced by the sweeper task via ``conn.phase`` —
-        the reads themselves carry no timers."""
-        first = await reader.read(1)
-        if not first:
-            return None  # EOF between requests (or watchdog close)
+        the reads themselves carry no timers.
+
+        Read incrementally rather than with ``readuntil``: the stream
+        limit is sized for bulk import BODIES (STREAM_BUFFER_BYTES), so
+        the MAX_HEADER_BYTES cap must be enforced here, MID-STREAM — a
+        header flood has to die at the cap, not once a terminator shows
+        up.  Bytes past the CRLFCRLF (a pipelined body prefix) stay in
+        ``conn.readahead`` for ``_read_body``."""
+        pending = conn.readahead
+        conn.readahead = b""
+        if not pending:
+            first = await reader.read(1)
+            if not first:
+                return None  # EOF between requests (or watchdog close)
+            pending = first
         conn.enter(_ConnState.HEAD)
-        try:
-            rest = await reader.readuntil(b"\r\n\r\n")
-        except asyncio.IncompleteReadError:
-            return None  # hung up mid-head, or the sweeper's 408 cut
-        except asyncio.LimitOverrunError:
-            raise _Abort(
-                431, "header_too_large",
-                f"request head exceeds {MAX_HEADER_BYTES} bytes",
-            ) from None
-        return first + rest
+        buf = bytearray(pending)
+        while True:
+            idx = buf.find(b"\r\n\r\n")
+            if idx >= 0:
+                head = bytes(buf[: idx + 4])
+                if len(head) > MAX_HEADER_BYTES:
+                    raise _Abort(
+                        431, "header_too_large",
+                        f"request head exceeds {MAX_HEADER_BYTES} bytes",
+                    )
+                conn.readahead = bytes(buf[idx + 4 :])
+                return head
+            if len(buf) > MAX_HEADER_BYTES:
+                raise _Abort(
+                    431, "header_too_large",
+                    f"request head exceeds {MAX_HEADER_BYTES} bytes",
+                )
+            chunk = await reader.read(65536)
+            if not chunk:
+                return None  # hung up mid-head, or the sweeper's 408 cut
+            buf += chunk
 
     def _parse_head(self, head: bytes) -> tuple[str, str, dict, bytes]:
         """(method, path, lowercase-header dict, possibly-rewritten head).
@@ -598,12 +634,20 @@ class EventHTTPServer(_ServerCore):
         if length <= 0:
             return b""
         conn.enter(_ConnState.BODY)  # sweeper owns the slow-body cut
+        pending = conn.readahead
+        if pending:
+            # body prefix already buffered by the incremental head read
+            if len(pending) >= length:
+                conn.readahead = pending[length:]
+                return pending[:length]
+            conn.readahead = b""
         try:
-            return await reader.readexactly(length)
+            rest = await reader.readexactly(length - len(pending))
         except asyncio.IncompleteReadError:
             if not conn.aborted:
                 self.stats.count("connections_aborted_midbody")
             return None
+        return pending + rest if pending else rest
 
     async def _admit_and_dispatch(self, writer, cls: str,
                                   raw: bytes, deadline,

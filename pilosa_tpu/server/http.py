@@ -719,18 +719,48 @@ class Handler(BaseHTTPRequestHandler):
         else:
             self._json({"success": True})
 
+    def _record_ingest(
+        self, route: str, nbytes: int, bits: int = 0, started: float | None = None
+    ) -> None:
+        """Ingest observability (docs/ingest.md): per-route byte/bit
+        counters + the batch-latency histogram, and the rolling meter
+        the /debug/resources "ingest" row reads."""
+        meter = getattr(self.server, "ingest_meter", None)
+        if meter is not None:
+            meter.record(nbytes, bits)
+        if self.stats is not None:
+            self.stats.count("import_bytes_total", nbytes, tags={"route": route})
+            if bits:
+                self.stats.count("import_bits_total", bits)
+            if started is not None:
+                self.stats.timing(
+                    "import_batch_seconds", time.perf_counter() - started
+                )
+
     def h_import_bits(self, index: str, field: str) -> None:
         if not self._gate():
             return
+        t0 = time.perf_counter()
+        body_len = int(self.headers.get("Content-Length") or 0)
         payload = self._import_payload(values=False)
         self.server.import_router(index, field, payload, values=False)
+        cols = payload.get("columnIDs")
+        self._record_ingest(
+            "import", body_len, len(cols) if cols is not None else 0, t0
+        )
         self._import_ok()
 
     def h_import_values(self, index: str, field: str) -> None:
         if not self._gate():
             return
+        t0 = time.perf_counter()
+        body_len = int(self.headers.get("Content-Length") or 0)
         payload = self._import_payload(values=True)
         self.server.import_router(index, field, payload, values=True)
+        cols = payload.get("columnIDs")
+        self._record_ingest(
+            "import-value", body_len, len(cols) if cols is not None else 0, t0
+        )
         self._import_ok()
 
     def h_import_roaring(self, index: str, field: str, shard: str) -> None:
@@ -746,7 +776,11 @@ class Handler(BaseHTTPRequestHandler):
         else:
             data = self._body()
             view = param_view or "standard"
-        self.api.import_roaring(index, field, int(shard), data, view=view)
+        t0 = time.perf_counter()
+        # clustered nodes swap this router for the replica fan-out that
+        # streams the SAME frame bytes to every shard owner
+        bits = self.server.roaring_router(index, field, int(shard), data, view)
+        self._record_ingest("import-roaring", len(data), int(bits or 0), t0)
         self._import_ok()
 
     def h_console(self) -> None:
@@ -988,6 +1022,22 @@ class Handler(BaseHTTPRequestHandler):
         max_debt = getattr(self.server, "compaction_max_debt", 0) or 0
         row("compaction", debt, max_debt, "compactions",
             workers=comp.workers)
+        # bulk-ingest lane (docs/ingest.md): rolling window throughput +
+        # lifetime totals from the import routes' meter
+        meter = getattr(self.server, "ingest_meter", None)
+        if meter is not None:
+            ing = meter.snapshot()
+            row(
+                "ingest",
+                ing["bytesTotal"],
+                None,
+                "bytes",
+                bitsTotal=ing["bitsTotal"],
+                postsTotal=ing["postsTotal"],
+                windowSeconds=ing["windowSeconds"],
+                recentBytesPerS=ing["recentBytesPerS"],
+                recentMbitSetPerS=ing["recentMbitSetPerS"],
+            )
         # evidence rings
         rec = getattr(self.server, "flightrec", None)
         if rec is not None:
@@ -1322,6 +1372,14 @@ class _ServerCore:
         # dispatch/readback waves (docs/query-batching.md)
         self.query_router = lambda index, pql, shards: api.query(index, pql, shards)
         self.import_router = self._local_import
+        # bulk-lane twin of import_router: the cluster layer swaps this
+        # for the replica fan-out (identical frame bytes to all owners)
+        self.roaring_router = self._local_roaring
+        # ingest throughput meter behind the /debug/resources "ingest"
+        # row and the import_* metric family (docs/ingest.md)
+        from pilosa_tpu.utils.stats import IngestMeter
+
+        self.ingest_meter = IngestMeter()
         # cluster layer swaps this for a primary-forwarding version — ID
         # allocation on a non-primary node would fork the key space
         self.translate_router = (
@@ -1337,6 +1395,11 @@ class _ServerCore:
             self.api.import_values(index, field, payload)
         else:
             self.api.import_bits(index, field, payload)
+
+    def _local_roaring(
+        self, index: str, field: str, shard: int, data: bytes, view: str
+    ) -> int:
+        return self.api.import_roaring(index, field, shard, data, view=view)
 
     def handle_extra(self, handler: Handler, method: str, path: str) -> bool:
         for (m, pattern), fn in self.extra_routes.items():

@@ -49,6 +49,7 @@ class Server:
             os.path.expanduser(self.config.data_dir),
             compaction_workers=self.config.compaction_workers,
             load_workers=self.config.holder_load_workers,
+            load_min_fragments=self.config.holder_load_min_fragments,
             stats=self.stats,
         )
         self.cluster = None

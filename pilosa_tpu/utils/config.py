@@ -176,6 +176,11 @@ class Config:
     # fragment, not the sum; <=1 loads serially. Device upload stays
     # lazy (first query per stack) either way.
     holder_load_workers: int = 8
+    # fragment-count floor below which Holder.open loads serially even
+    # with workers configured: at small counts pool spin-up costs more
+    # than it overlaps (BENCH_INGEST_r08: parallel 0.159s vs serial
+    # 0.066s over 12 fragments). 0 always parallelizes.
+    holder_load_min_fragments: int = 32
     # flight recorder (docs/observability.md): always-on tail-based
     # retention of slow/errored query evidence, served by GET
     # /debug/flightrec. Disabling it removes the retention decision from
@@ -379,6 +384,7 @@ def config_template() -> str:
         "compaction-workers = 1\n"
         "compaction-max-debt = 64\n"
         "holder-load-workers = 8\n"
+        "holder-load-min-fragments = 32\n"
         "flightrec-enabled = true\n"
         "flightrec-entries = 256\n"
         "flightrec-min-ms = 25.0\n"

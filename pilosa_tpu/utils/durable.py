@@ -314,6 +314,24 @@ def open_wal(path: str, mode: str = "a"):
     return open(path, mode)
 
 
+def wal_write(f, data: str | bytes, path: str) -> None:
+    """One append through a RETAINED WAL handle with the full durability
+    contract applied: fault-hook check + (torn-write-capable) write,
+    flush, then per-mode durability bookkeeping. The batched translate-
+    key allocator writes one record batch per call — one append, one
+    flush, one group-commit mark, regardless of how many keys the batch
+    carries (docs/ingest.md)."""
+    if isinstance(data, str):
+        data = data.encode("utf-8")
+    _check("wal-append", path)
+    # text-mode handles (the translate log) can't take bytes: write via
+    # the underlying buffer so the torn-write cap operates on raw bytes
+    sink = f.buffer if hasattr(f, "buffer") else f
+    _write(sink, data, "wal-append", path)
+    f.flush()
+    wal_written(path, f.fileno())
+
+
 def wal_written(path: str, fileno: int | None = None) -> None:
     """Durability bookkeeping for a WAL write that already reached the
     OS (flushed): fsync now (``always``), mark for the next

@@ -487,3 +487,56 @@ class NopStats(StatsClient):
 
     def observe(self, *a, **k):
         pass
+
+
+class IngestMeter:
+    """Rolling ingest-throughput accounting (docs/ingest.md): lifetime
+    totals plus a sliding-window rate, read by the /debug/resources
+    "ingest" row so an operator can see sustained Mbit/s without
+    scraping counters twice and differencing. Window math is monotonic
+    throughout."""
+
+    WINDOW_S = 60.0
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.bytes_total = 0
+        self.bits_total = 0
+        self.posts_total = 0
+        self._events: list[tuple[float, int, int]] = []
+
+    def record(self, nbytes: int, bits: int = 0) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.bytes_total += nbytes
+            self.bits_total += bits
+            self.posts_total += 1
+            self._events.append((now, nbytes, bits))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        cut = now - self.WINDOW_S
+        i = bisect.bisect_right(self._events, (cut, 1 << 62, 1 << 62))
+        if i:
+            del self._events[:i]
+
+    def snapshot(self) -> dict:
+        now = time.monotonic()
+        with self._lock:
+            self._trim(now)
+            if self._events:
+                span = max(now - self._events[0][0], 1e-9)
+                wb = sum(e[1] for e in self._events)
+                wbits = sum(e[2] for e in self._events)
+            else:
+                span, wb, wbits = 0.0, 0, 0
+            return {
+                "bytesTotal": self.bytes_total,
+                "bitsTotal": self.bits_total,
+                "postsTotal": self.posts_total,
+                "windowSeconds": round(min(span, self.WINDOW_S), 3),
+                "recentBytesPerS": round(wb / span, 1) if span else 0.0,
+                "recentMbitSetPerS": (
+                    round(wbits / span / 1e6, 4) if span else 0.0
+                ),
+            }

@@ -8,7 +8,13 @@ batch is ACKNOWLEDGED on stdout only after its durability barrier
 returns, so the parent can assert the recovery invariant: zero
 acknowledged batches lost across the kill.
 
-Usage: python _durability_child.py <data_dir> <rules_json> [wal_mode]
+Usage: python _durability_child.py <data_dir> <rules_json> [wal_mode] [lane]
+
+``lane`` selects the ingest path the batches travel (default "bits"):
+  bits      — the per-bit lane (field.import_bulk → OP_ADD records)
+  roaring   — the wire-speed bulk lane (serialized frames adopted via
+              one union-op WAL append each; docs/ingest.md)
+  translate — batched key allocation (one WAL append per key batch)
 
 Not collected by pytest (no ``test_`` prefix).
 """
@@ -22,11 +28,13 @@ os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
 import numpy as np
 
 from pilosa_tpu.core import Holder
+from pilosa_tpu.core.translate import TranslateStore
 from pilosa_tpu.parallel.faultinject import FSFaultInjector
 from pilosa_tpu.utils import durable
 
 BATCHES = 400
 BITS_PER_BATCH = 8
+KEYS_PER_BATCH = 16
 
 
 def batch_bits(b: int) -> tuple[np.ndarray, np.ndarray]:
@@ -39,10 +47,32 @@ def batch_bits(b: int) -> tuple[np.ndarray, np.ndarray]:
     return rows, cols
 
 
+def batch_keys(b: int) -> list:
+    """Deterministic per-batch key set for the translate lane."""
+    return [f"key_{b}_{i}" for i in range(KEYS_PER_BATCH)]
+
+
+def run_translate_lane(data_dir: str, rules) -> int:
+    """Batched key allocation under fire: ACK only after the batch's
+    single WAL append has passed the durability barrier."""
+    store = TranslateStore(os.path.join(data_dir, "keys.jsonl"))
+    store.open()
+    durable.install_fs_hook(FSFaultInjector(rules, seed=7))
+    for b in range(BATCHES):
+        store.translate_keys(batch_keys(b))
+        durable.ack_barrier()
+        print(f"ACK {b}", flush=True)
+    store.close()
+    return 0
+
+
 def main() -> int:
     data_dir = sys.argv[1]
     rules = json.loads(sys.argv[2])
     durable.set_wal_fsync_mode(sys.argv[3] if len(sys.argv) > 3 else "batch")
+    lane = sys.argv[4] if len(sys.argv) > 4 else "bits"
+    if lane == "translate":
+        return run_translate_lane(data_dir, rules)
     h = Holder(data_dir, compaction_workers=1)
     h.open()
     idx = h.create_index("i")
@@ -50,6 +80,20 @@ def main() -> int:
     # arm AFTER the schema writes: the rules aim at fragment I/O (the
     # parent scopes them by path substring + occurrence count anyway)
     durable.install_fs_hook(FSFaultInjector(rules, seed=7))
+    if lane == "roaring":
+        from pilosa_tpu.roaring import build as rb
+
+        view = fld.create_view_if_not_exists("standard")
+        frag = view.create_fragment_if_not_exists(0)
+        frag.max_op_n = 8
+        for b in range(BATCHES):
+            rows, cols = batch_bits(b)
+            frame = rb.shard_payloads(rows, cols)[0][1]
+            frag.import_roaring(frame)
+            durable.ack_barrier()
+            print(f"ACK {b}", flush=True)
+        h.close()
+        return 0
     for b in range(BATCHES):
         rows, cols = batch_bits(b)
         fld.import_bulk(rows, cols)

@@ -96,6 +96,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "ingest: wire-speed bulk-ingest suite — vectorized container "
+        "builders, roaring WAL-adopt, batched key translation, loader "
+        "backoff, bulk-lane crash recovery (tests/test_ingest.py; runs "
+        "in tier-1 — the marker exists so `pytest -m ingest` scopes to "
+        "it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

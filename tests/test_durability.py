@@ -992,3 +992,100 @@ def test_kill9_zero_acknowledged_writes_lost(tmp_path, point, rule):
         )
     finally:
         h.close()
+
+
+# -------------------------------------- kill-9, bulk-ingest lanes (slow)
+# ISSUE 14 satellite: the wire-speed lanes (docs/ingest.md) join the
+# chaos matrix — death mid roaring-adopt WAL append and mid
+# batched-translate append, zero acknowledged loss either way.
+BULK_KILL_POINTS = [
+    # mid roaring-adopt append: the union-op record (a whole serialized
+    # frame) is cut short ON DISK, then SIGKILL — recovery must truncate
+    # the torn frame and keep every acked one
+    # cap 17 cuts just past the record header, inside the frame body
+    # (an 8-bit batch's whole union record is only 40 bytes)
+    ("mid-roaring-adopt-append", "roaring",
+     {"op": "wal-append", "action": "torn", "cap_bytes": 17,
+      "then": "kill", "path": "fragments/", "after": 120}),
+    # mid batched-translate append: one batch's joined JSONL record cut
+    # mid-line, then SIGKILL — the reopen truncates the partial line
+    ("mid-batched-translate-append", "translate",
+     {"op": "wal-append", "action": "torn", "cap_bytes": 11,
+      "then": "kill", "path": "keys.jsonl", "after": 120}),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "point,lane,rule", BULK_KILL_POINTS, ids=[p for p, _, _ in BULK_KILL_POINTS]
+)
+def test_kill9_bulk_lanes_zero_acknowledged_loss(tmp_path, point, lane, rule):
+    data_dir = str(tmp_path / "holder")
+    os.makedirs(data_dir, exist_ok=True)
+    env = dict(os.environ, PILOSA_TPU_SHARD_WIDTH_EXP="16",
+               JAX_PLATFORMS="cpu", PYTHONPATH=str(REPO))
+    proc = subprocess.run(
+        [sys.executable, str(CHILD), data_dir, json.dumps([rule]),
+         "batch", lane],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env=env,
+        cwd=REPO,
+    )
+    assert proc.returncode == -9, (
+        f"{point}: child must die by SIGKILL at the armed point "
+        f"(rc={proc.returncode})\n{proc.stdout}\n{proc.stderr}"
+    )
+    acked = [
+        int(line.split()[1])
+        for line in proc.stdout.splitlines()
+        if line.startswith("ACK ")
+    ]
+    assert acked, f"{point}: no batch was acknowledged before the kill"
+    sys.path.insert(0, str(REPO / "tests"))
+    try:
+        from _durability_child import batch_bits, batch_keys
+    finally:
+        sys.path.pop(0)
+    if lane == "translate":
+        from pilosa_tpu.core.translate import TranslateStore
+
+        store = TranslateStore(os.path.join(data_dir, "keys.jsonl"))
+        store.open()
+        try:
+            lost = []
+            for b in acked:
+                ids = store.translate_keys(batch_keys(b), create=False)
+                lost.extend(
+                    (b, k) for k, i in zip(batch_keys(b), ids) if i is None
+                )
+            assert not lost, (
+                f"{point}: {len(lost)} acknowledged key bindings lost "
+                f"after SIGKILL: {lost[:5]}"
+            )
+            # bidirectional map consistency after the torn-tail repair
+            for k, i in store._by_key.items():
+                assert store._by_id[i] == k
+        finally:
+            store.close()
+        return
+    h = Holder(data_dir)
+    h.open()
+    try:
+        frag = h.index("i").field("f").view("standard").fragment(0)
+        assert frag is not None
+        assert not (frag.last_recovery or {}).get("quarantined", False)
+        assert not (frag.last_recovery or {}).get("corrupt", False)
+        lost = []
+        for b in acked:
+            rows, cols = batch_bits(b)
+            for r, c in zip(rows.tolist(), cols.tolist()):
+                if not frag.contains(r, c):
+                    lost.append((b, r, c))
+        assert not lost, (
+            f"{point}: {len(lost)} acknowledged bits lost after SIGKILL "
+            f"(acked through batch {acked[-1]}): {lost[:5]}"
+        )
+    finally:
+        h.close()
