@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload bench-profile
+.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -52,3 +52,10 @@ bench-profile:
 # ordering and fidelity-ratio gates
 bench-workload:
 	set -o pipefail; PILOSA_BENCH_ALL_CHILD=workload python bench_all.py | tee BENCH_WORKLOAD_r11.json
+
+# mutation-stamped result-cache row (docs/result-cache.md): Zipfian mix
+# hit fraction, hot-tail QPS of event-loop hits vs the cache-off
+# baseline (exits non-zero below 5x), and cache-on vs cache-off c1 p50
+# on never-repeating shapes (exits non-zero past 1.03x)
+bench-cache:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=cache python bench_all.py | tee BENCH_CACHE_r17.json

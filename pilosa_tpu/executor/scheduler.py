@@ -113,6 +113,42 @@ def stack_token(idx) -> tuple:
     return (tok, n)
 
 
+def canonical_calls(calls) -> tuple:
+    """The canonical call-repr tuple of ``dedup_key``, rendered at most
+    once per parsed call object (cached on the Call): within one
+    request the result cache's memoize and fill legs plus this
+    scheduler's single-flight key would otherwise each re-render the
+    same tree — ~10µs a pass — on the query's critical path.  Safe
+    because call trees are treated immutable after parse."""
+    out = []
+    for c in calls:
+        canon = getattr(c, "_canon", None)
+        if canon is None:
+            canon = repr(c)
+            try:
+                c._canon = canon
+            except AttributeError:
+                pass  # a slotted/foreign call type: render every time
+        out.append(canon)
+    return tuple(out)
+
+
+def dedup_key(index: str, calls, shards, idx) -> tuple:
+    """The single-flight identity: ``(index, canonical calls, shard
+    scope, mutation stamp)``.  Two queries may share one answer exactly
+    when these keys are equal — the law the wave dedup below applies to
+    in-flight executions and the cross-request result cache
+    (utils/resultcache.py) applies to settled ones, so the key shape
+    MUST stay shared: a drift between them would let the cache serve
+    across a boundary dedup would not."""
+    return (
+        index,
+        canonical_calls(calls),
+        tuple(shards) if shards is not None else None,
+        stack_token(idx),
+    )
+
+
 class _WorkItem:
     __slots__ = (
         "index",
@@ -239,12 +275,7 @@ class WaveScheduler:
                 self.direct_queries += 1
             return executor.execute(index, calls, shards=shards, routes=routes)
         item = _WorkItem(index, calls, shards, routes=routes)
-        item.key = (
-            index,
-            tuple(repr(c) for c in calls),
-            tuple(shards) if shards is not None else None,
-            stack_token(idx),
-        )
+        item.key = dedup_key(index, calls, shards, idx)
         item.trace_ctx = GLOBAL_TRACER.current_context()
         item.profile = tracing.current_profile()
         joined = False
@@ -326,12 +357,7 @@ class WaveScheduler:
                         )
                     continue
                 item = _WorkItem(index, calls, shards, routes=_routes)
-                item.key = (
-                    index,
-                    tuple(repr(c) for c in calls),
-                    tuple(shards) if shards is not None else None,
-                    stack_token(idx),
-                )
+                item.key = dedup_key(index, calls, shards, idx)
                 item.trace_ctx = ctx
                 wave_items.append((i, item))
             except Exception as e:  # noqa: BLE001 — per-entry isolation:

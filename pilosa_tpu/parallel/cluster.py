@@ -408,6 +408,12 @@ class Cluster:
         router (which rejects with 503 while STARTING), never the local
         default router; peers probing /internal/* must not see 404."""
         self._mount_internal_routes()
+        # results cached while this node served solo were never covered
+        # by peer invalidation broadcasts — drop them before the first
+        # clustered request can read one
+        cache = getattr(self.server.api, "result_cache", None)
+        if cache is not None:
+            cache.clear()
         self.server.http.trace_fetch = self._fetch_cluster_trace
         self.server.http.query_router = self.query
         self.server.http.import_router = self.import_router
@@ -1170,6 +1176,31 @@ class Cluster:
         calls = parse(pql)
         api = self.server.api
         api.check_write_limit(api.count_query_writes(calls), "query")
+        # coordinator-side result-cache consult BEFORE the fan-out: a
+        # hit spends zero RPCs and zero remote device waves.  The key's
+        # mutation stamp is THIS node's — remote writes that bypassed
+        # this coordinator are covered by the write-path invalidation
+        # broadcast (every coordinator write path calls
+        # _broadcast_cache_invalidate before its ack returns).
+        cache = getattr(api, "result_cache", None)
+        key = None
+        gen = 0
+        t0 = 0.0
+        has_write = any(
+            unwrap_options(c).name in WRITE_CALLS for c in calls
+        )
+        if cache is not None and cache.enabled:
+            # teach the event-loop fast path this text's identity (the
+            # loop itself never parses — docs/result-cache.md)
+            cache.memoize_pql(pql, None if has_write else calls)
+        if cache is not None and cache.enabled and not has_write:
+            key = api._result_cache_key(index, calls, shards)
+            if key is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit.resp
+                gen = cache.generation(index)
+                t0 = time.perf_counter()
         results = []
         wrote = False
         for call in calls:
@@ -1188,6 +1219,8 @@ class Cluster:
             # group-fsync them before the acknowledgement leaves, same
             # contract as the single-node api.query (docs/durability.md)
             durable.ack_barrier()
+            api._invalidate_results(index)
+            self._broadcast_cache_invalidate(index)
         resp = self.server.api.build_response(results)
         qctx = resilience.current_query_context()
         if qctx is not None and qctx.partial_shards:
@@ -1197,7 +1230,42 @@ class Cluster:
             # thing this path must never produce
             resp["partialShards"] = sorted(set(qctx.partial_shards))
             self.server.stats.count("queries_partial")
+            # a degraded answer must never be served to later full-
+            # replica requests from cache
+            key = None
+        if key is not None:
+            cache.offer(key, resp, time.perf_counter() - t0, gen=gen)
         return resp
+
+    def _broadcast_cache_invalidate(self, index: str) -> None:
+        """A write acknowledged by THIS node must not leave a bystander
+        peer serving its pre-write cached results: a non-owner's
+        mutation stamp never moves on a remote write, so its result-
+        cache keys still verify against stale entries.  Synchronous
+        best-effort POST to every alive peer before the write's ack
+        returns; an unreachable peer's staleness window is bounded by
+        the cache's revalidate-every-N countdown (docs/result-cache.md)."""
+        cache = getattr(self.server.api, "result_cache", None)
+        if cache is None or not cache.enabled:
+            return
+        for n in self._peers():
+            try:
+                self.client._json(
+                    "POST",
+                    n.uri,
+                    "/internal/cache/invalidate",
+                    {"index": index},
+                )
+            except PeerError:
+                pass
+
+    def _h_cache_invalidate(self, handler) -> None:
+        """Receiver half of the write-path invalidation broadcast: a
+        remote write doesn't move this node's mutation stamp, so the
+        stamp check alone cannot retire entries it dirtied."""
+        body = handler._json_body()
+        self.server.api._invalidate_results(body["index"])
+        handler._json({"success": True})
 
     def _route_read(self, index: str, call: Call, shards: list[int] | None) -> Any:
         # scatter only the inner call of an Options() wrapper: result
@@ -1967,6 +2035,9 @@ class Cluster:
         # replica-side durability barrier: the RPC ack this write rides
         # back on is an acknowledgement too (docs/durability.md)
         durable.ack_barrier()
+        # attr writes never move the mutation stamp — this hook is the
+        # ONLY thing keeping this replica's cached results honest
+        self.server.api._invalidate_results(payload["index"])
 
     # -------------------------------------------------------------- imports
     def import_router(self, index: str, field: str, payload: dict, values: bool) -> None:
@@ -2089,6 +2160,11 @@ class Cluster:
                 for uri in took_write.get(sh, []):
                     entries.setdefault(uri, []).append(sh)
             self._announce_shards(index, entries)
+        # the local applies invalidated through api.import_*'s own hook,
+        # but a coordinator that owns NONE of the shards never moved its
+        # own stamp — and neither did any bystander peer
+        api._invalidate_results(index)
+        self._broadcast_cache_invalidate(index)
 
     def import_roaring_router(
         self, index: str, field: str, shard: int, data: bytes, view: str
@@ -2159,6 +2235,10 @@ class Cluster:
             # owners that actually took the frame (same read-your-writes
             # rule as import_router)
             self._announce_shards(index, {u: [sh] for u in took_write})
+        # same rule as import_router: a non-owner coordinator's stamp
+        # (and every bystander's) never moved — invalidate explicitly
+        api._invalidate_results(index)
+        self._broadcast_cache_invalidate(index)
         return bits
 
     # ---------------------------------------------------------- translation
@@ -2765,6 +2845,10 @@ class Cluster:
                 "POST",
                 re.compile(r"^/internal/shards/announce$"),
             ): self._h_shards_announce,
+            (
+                "POST",
+                re.compile(r"^/internal/cache/invalidate$"),
+            ): self._h_cache_invalidate,
         }
         http.extra_routes.update(routes)
 
@@ -2825,6 +2909,7 @@ class Cluster:
             # rides back on IS the coordinator's acknowledgement — its
             # ops-log appends must be on disk first (docs/durability.md)
             durable.ack_barrier()
+            self.server.api._invalidate_results(body["index"])
         # framed response: JSON control + raw packed-word blobs — a wide
         # Row() partial crosses the wire at 4 bytes/word instead of
         # base64's 5.33 plus JSON string parse (reference: internal
@@ -2850,13 +2935,27 @@ class Cluster:
             )
         entries = body.get("queries", [])
         stats = self.server.stats
+        api = self.server.api
         reqs = []
+        wrote_indexes: set[str] = set()
         for q in entries:
             stats.count("queries_served", tags={"path": "remote"})
+            q_calls = q["query"]
+            if isinstance(q_calls, str):
+                try:
+                    q_calls = parse(q_calls)
+                except Exception:  # noqa: BLE001 — per-entry isolation:
+                    # execute_many re-parses and makes the parse error
+                    # this slot's answer; its batch-mates still execute
+                    pass
+            if not isinstance(q_calls, str) and api.count_query_writes(
+                q_calls
+            ):
+                wrote_indexes.add(q["index"])
             reqs.append(
                 (
                     q["index"],
-                    q["query"],
+                    q_calls,
                     q.get("shards"),
                     (q.get("traceId"), q.get("parentSpanId")),
                 )
@@ -2865,6 +2964,13 @@ class Cluster:
             with stats.timer("internal_query_batch_seconds"):
                 with self._hop_query_context(handler):
                     results = self.server.api.scheduler.execute_many(reqs)
+        if wrote_indexes:
+            # the batcher coalesces read fan-out legs, but the RPC shape
+            # doesn't FORBID writes — hold them to the same ack-barrier
+            # and cache-invalidation contract as _h_query
+            durable.ack_barrier()
+            for name in sorted(wrote_indexes):
+                api._invalidate_results(name)
         blobs: list[bytes] = []
         out: list[dict] = []
         for r in results:
@@ -3274,6 +3380,9 @@ class Cluster:
         # replicate-before-ack only holds if the replica's copy is ON
         # DISK when the primary's push returns (docs/durability.md)
         durable.ack_barrier()
+        # adopted bindings can change how cached results keyed under the
+        # old (stamp-blind) translate state would decode — retire them
+        self.server.api._invalidate_results(body["index"])
         handler._json({"applied": True})
 
 

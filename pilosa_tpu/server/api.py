@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import io
 import re
+import time
 from datetime import datetime
 from typing import Any
 
@@ -125,6 +126,12 @@ class API:
             max_queries=batch_max_queries,
         )
         self.diagnostics = None  # set by Server.open
+        # mutation-stamped cross-request result cache (utils/
+        # resultcache.py, docs/result-cache.md).  None ⇒ uncached:
+        # the serving front ends install one (_ServerCore default,
+        # Server.open config-sized) — a bare API façade in tests keeps
+        # its exact pre-cache semantics.
+        self.result_cache = None
 
     def attach_mesh(self, mesh_ctx) -> None:
         """Late mesh attachment (Server.open does this after the HTTP
@@ -154,16 +161,20 @@ class API:
 
     def delete_index(self, name: str) -> None:
         self.holder.delete_index(name)
+        self._invalidate_results(name)
 
     def create_field(self, index: str, name: str, options: dict | None = None) -> Field:
         validate_name(name, "field name")
         idx = self._index(index)
-        return idx.create_field(
+        f = idx.create_field(
             name, field_options_from_json(options or {}, explicit_create=True)
         )
+        self._invalidate_results(index)
+        return f
 
     def delete_field(self, index: str, name: str) -> None:
         self._index(index).delete_field(name)
+        self._invalidate_results(index)
 
     def schema(self) -> dict:
         return {"indexes": self.holder.schema()}
@@ -192,6 +203,10 @@ class API:
                     idx.create_field(
                         f_def["name"], field_options_from_json(f_def.get("options", {}))
                     )
+        for idx_def in schema.get("indexes", []):
+            # schema application changes what keys/fields resolve —
+            # every named index's cached results are stale generations
+            self._invalidate_results(idx_def["name"])
         if self.cluster is not None:
             # a keyed store learned AFTER this node's promotion fence was
             # stamped would allocate from an empty counter (the fence
@@ -228,17 +243,42 @@ class API:
             # single-node served-query counter; clustered serving counts
             # per fan-out leg in parallel/cluster.py instead
             self.stats.count("queries_served", tags={"path": "local"})
+        # read queries consult the result cache BEFORE execution: the
+        # key embeds the index's current mutation stamp, so a hit is a
+        # settled answer computed under this exact data generation
+        # (docs/result-cache.md); key + invalidation generation are
+        # snapshotted pre-execution so a result computed before a
+        # concurrent write can never be stored under post-write state
+        cache = self.result_cache
+        key = gen = None
+        if cache is not None and cache.enabled and isinstance(pql, str):
+            # teach the event-loop fast path this text's identity (the
+            # loop itself never parses — docs/result-cache.md)
+            cache.memoize_pql(pql, None if n_writes else calls)
+        if n_writes == 0 and cache is not None and cache.enabled:
+            key = self._result_cache_key(index, calls, shards)
+            if key is not None:
+                hit = cache.get(key)
+                if hit is not None:
+                    return hit.resp
+                gen = cache.generation(index)
+        t0 = time.perf_counter()
         # sync queries go to the wave scheduler, not straight to
         # execute: concurrent device-routed requests coalesce into
         # shared dispatch/readback waves (writes and host-routed reads
         # pass through direct — see executor/scheduler.py)
         results = self.scheduler.execute(index, calls, shards=shards)
+        elapsed = time.perf_counter() - t0
         if n_writes:
             # durability barrier BEFORE the acknowledgement leaves: in
             # batch WAL mode this group-fsyncs every ops log the query
             # dirtied (docs/durability.md)
             durable.ack_barrier()
-        return self.build_response(results)
+            self._invalidate_results(index)
+        resp = self.build_response(results)
+        if key is not None:
+            cache.offer(key, resp, elapsed, gen=gen)
+        return resp
 
     def explain(self, index: str, pql: str, shards: list[int] | None = None) -> dict:
         """EXPLAIN (plan only — docs/observability.md): the decisions
@@ -283,8 +323,52 @@ class API:
                 "reason": why,
                 "occupancyEwma": router.wave_occupancy.value,
             },
+            "resultCache": self._explain_result_cache(
+                index, calls, shards, has_write
+            ),
             "calls": plans,
         }
+
+    def _explain_result_cache(
+        self, index: str, calls: list, shards, has_write: bool
+    ) -> dict:
+        """EXPLAIN's cache verdict (docs/result-cache.md): whether this
+        exact key is cached RIGHT NOW, and the structural admission
+        candidacy.  The HTTP layer enriches the verdict with the
+        workload plane's measured per-fingerprint cost/bytes."""
+        cache = self.result_cache
+        if cache is None:
+            return {"enabled": False, "reason": "no result cache wired"}
+        out = {"enabled": cache.enabled, "mode": cache.mode}
+        key = (
+            self._result_cache_key(index, calls, shards)
+            if not has_write
+            else None
+        )
+        out["cachedNow"] = key is not None and cache.contains(key)
+        out.update(cache.candidacy(index, has_write))
+        return out
+
+    def _result_cache_key(self, index: str, calls: list, shards) -> tuple | None:
+        """This query's single-flight dedup identity (executor/
+        scheduler.py dedup_key) — the result cache's key.  None when
+        the index is gone (the caller's execution will raise the
+        canonical error)."""
+        idx = self.holder.index(index)
+        if idx is None:
+            return None
+        from pilosa_tpu.executor.scheduler import dedup_key
+
+        return dedup_key(index, calls, shards, idx)
+
+    def _invalidate_results(self, index: str) -> None:
+        """The write-path invalidation hook: EVERY API write path must
+        reach this (enforced by the cacheinvariant analyzer rule).
+        Correctness for stamp-blind attribute writes, byte reclamation
+        for stamp-bumping ones (docs/result-cache.md)."""
+        cache = self.result_cache
+        if cache is not None:
+            cache.invalidate(index)
 
     def mutation_stamp(self, index: str) -> tuple | None:
         """The index's current view-version mutation stamp — the SAME
@@ -350,6 +434,7 @@ class API:
         f.import_bulk(rows, cols, timestamps=timestamps, clear=payload.get("clear", False))
         idx.mark_columns_exist(cols)
         durable.ack_barrier()  # acknowledged ⇒ on disk (docs/durability.md)
+        self._invalidate_results(index)
 
     def import_values(self, index: str, field: str, payload: dict) -> None:
         """Bulk BSI import (reference: api.ImportValue)."""
@@ -360,6 +445,7 @@ class API:
         if payload.get("clear"):
             f.clear_values(cols)
             durable.ack_barrier()
+            self._invalidate_results(index)
             return
         values = np.asarray(payload.get("values", []), dtype=np.int64)
         if cols.size != values.size:
@@ -367,6 +453,7 @@ class API:
         f.import_values(cols, values)
         idx.mark_columns_exist(cols)
         durable.ack_barrier()  # acknowledged ⇒ on disk (docs/durability.md)
+        self._invalidate_results(index)
 
     def import_roaring(self, index: str, field: str, shard: int, data: bytes, view: str = VIEW_STANDARD) -> int:
         """Direct roaring-bitmap union into a fragment (reference:
@@ -399,6 +486,7 @@ class API:
         # fragment's union-frame append AND the existence-field appends
         # in one pass (docs/durability.md, docs/ingest.md)
         durable.ack_barrier()
+        self._invalidate_results(index)
         # adopted bit count (the delta, deduplicated) — ingest metering
         return int(bits)
 
@@ -470,6 +558,10 @@ class API:
             # that writes bits under a returned id after a crash must
             # find the same mapping on replay
             durable.ack_barrier()
+            # a fresh mapping changes what keyed queries resolve to
+            # without touching any view version — stamp-blind, so the
+            # explicit hook is the only correctness mechanism here
+            self._invalidate_results(index)
         return ids
 
     # ------------------------------------------------------------- export

@@ -35,6 +35,7 @@ from __future__ import annotations
 import asyncio
 import io
 import os
+import re
 import socket
 import threading
 import time
@@ -526,6 +527,21 @@ class EventHTTPServer(_ServerCore):
                 return
             if body is None:
                 return  # client disconnected mid-body (or slow-body cut)
+            if cls == _CLASS_QUERY:
+                # result-cache fast path (docs/result-cache.md): a
+                # repeated read query whose mutation-stamped key is
+                # cached is answered RIGHT HERE on the loop thread —
+                # no admission lane, no worker-pool hop, no GIL-bound
+                # re-execution.  Pure CPU (memoized parse + dict hit),
+                # so the loop's no-blocking contract holds.
+                served = await self._serve_cached(
+                    writer, method, path, headers, body, arrival
+                )
+                if served is not None:
+                    if not served:
+                        return
+                    conn.enter(_ConnState.IDLE)
+                    continue
             conn.enter(_ConnState.BUSY)
             keep = await self._admit_and_dispatch(
                 writer, cls, head + body, deadline, arrival
@@ -648,6 +664,111 @@ class EventHTTPServer(_ServerCore):
                 self.stats.count("connections_aborted_midbody")
             return None
         return pending + rest if pending else rest
+
+    # public query path: POST /index/{name}/query, optionally with a
+    # ?shards= scope — the ONLY shape the cache fast path serves; any
+    # other param (explain/profile/...) or the /internal legs take the
+    # worker path untouched
+    _CACHE_PATH_RE = re.compile(r"^/index/([^/?]+)/query(?:\?(.*))?$")
+
+    async def _serve_cached(self, writer, method: str, path: str,
+                            headers: dict, body: bytes,
+                            arrival: float | None) -> bool | None:
+        """Serve a repeated read query straight from the event loop
+        (docs/result-cache.md).  Returns None when the worker path must
+        run, else the keep-alive verdict.  Everything here is pure CPU
+        — the asyncpurity contract for loop-thread code."""
+        cache = getattr(self, "result_cache", None)
+        if cache is None or not cache.enabled or method != "POST":
+            return None
+        m = self._CACHE_PATH_RE.match(path)
+        if m is None:
+            return None
+        index, qs = m.group(1), m.group(2) or ""
+        shards = None
+        if qs:
+            params = dict(
+                p.partition("=")[::2] for p in qs.split("&") if p
+            )
+            if set(params) - {"shards"}:
+                return None  # explain/profile/proto knobs: worker path
+            raw_shards = params.get("shards", "")
+            if raw_shards:
+                try:
+                    shards = [
+                        int(s) for s in raw_shards.split(",") if s != ""
+                    ]
+                except ValueError:
+                    return None  # malformed scope: worker owns the 4xx
+        # content negotiation: the cache holds JSON bytes — protobuf
+        # requests/accepts take the worker path (http.py _wants_proto)
+        if "protobuf" in headers.get("content-type", "") or (
+            "protobuf" in headers.get("accept", "")
+        ):
+            return None
+        t0 = time.perf_counter()
+        try:
+            pql = body.decode()
+        except UnicodeDecodeError:
+            return None
+        entry = cache.lookup_pql(self.api, index, pql, shards)
+        if entry is None:
+            return None
+        close = "close" in headers.get("connection", "").lower()
+        head = (
+            "HTTP/1.1 200 OK\r\n"
+            f"Server: pilosa-tpu/{__version__}\r\n"
+            "Content-Type: application/json\r\n"
+            f"Content-Length: {entry.nbytes}\r\n"
+            + ("Connection: close\r\n" if close else "")
+            + "\r\n"
+        ).encode()
+        writer.write(head + entry.body)
+        try:
+            await writer.drain()
+        except (ConnectionResetError, BrokenPipeError):
+            return False
+        elapsed = time.perf_counter() - t0
+        self.stats.count("queries_served", tags={"path": "cache"})
+        self._settle_cached(index, pql, shards, elapsed, entry.nbytes,
+                            arrival)
+        return not close
+
+    def _settle_cached(self, index: str, pql: str,
+                       shards: list[int] | None, elapsed: float,
+                       nbytes: int, arrival: float | None) -> None:
+        """Observability settle for a loop-served hit: the workload
+        plane and flight recorder must see cached serves too, or the
+        measured hit rate and the heavy-hitter ranks would go dark for
+        exactly the hottest traffic.  Spill is skipped (file I/O has no
+        place on the loop thread); the in-memory capture ring still
+        records."""
+        wl = getattr(self, "workload", None)
+        fp = None
+        if wl is not None and wl.enabled:
+            fp, call_type = wl.fingerprint(index, pql, shards)
+            wl.record(
+                index, pql, fp, call_type, elapsed, 200, nbytes,
+                route="cache", stamp=self.api.mutation_stamp(index),
+                arrival=arrival, shards=shards, spill=False,
+            )
+            wl.record_cache_hit(fp)
+        rec = getattr(self, "flightrec", None)
+        if rec is not None and rec.enabled:
+            call_type = pql.split("(", 1)[0].strip() or "?"
+
+            def entry() -> dict:
+                out = {
+                    "index": index,
+                    "query": pql[:500],
+                    "node": self.node_id,
+                    "resultCache": {"outcome": "hit"},
+                }
+                if fp is not None:
+                    out["fingerprint"] = fp
+                return out
+
+            rec.settle(call_type, elapsed, entry)
 
     async def _admit_and_dispatch(self, writer, cls: str,
                                   raw: bytes, deadline,

@@ -23,6 +23,7 @@ field names match the reference so existing clients port over:
 
 from __future__ import annotations
 
+import contextlib
 import json
 import re
 import threading
@@ -462,7 +463,9 @@ class Handler(BaseHTTPRequestHandler):
             # EXPLAIN (docs/observability.md): the plan alone — router
             # cost table per candidate path, residency classification,
             # mesh verdict, wave batchability — NOTHING executes
-            self._json({"explain": self.api.explain(index, pql, shards)})
+            plan = self.api.explain(index, pql, shards)
+            self._enrich_cache_candidacy(plan, index, pql, shards)
+            self._json({"explain": plan})
             return
         # EXPLAIN ANALYZE is JSON-only, like ?profile=true — a protobuf
         # QueryResponse has no explain slot, so don't pay the plan walk
@@ -472,7 +475,18 @@ class Handler(BaseHTTPRequestHandler):
         # estimates it shows are the ones this very run decided with
         # (execution feeds the calibration EWMAs, moving them)
         plan = self.api.explain(index, pql, shards) if analyze else None
+        if plan is not None:
+            self._enrich_cache_candidacy(plan, index, pql, shards)
         qctx = self._query_context()
+        # ?profile and EXPLAIN ANALYZE must measure a REAL execution —
+        # a cached serve has no per-call actuals; lookups are bypassed
+        # (fills still happen: a profiled run settles a valid result)
+        cache = getattr(self.api, "result_cache", None)
+        bypass = (
+            cache.bypass()
+            if cache is not None and (want_profile or analyze)
+            else contextlib.nullcontext()
+        )
         t0 = time.perf_counter()
         err: BaseException | None = None
         resp = None
@@ -487,13 +501,19 @@ class Handler(BaseHTTPRequestHandler):
                     with GLOBAL_TRACER.span("pql.query", index=index) as sp:
                         prof.trace_id = sp.trace_id
                         try:
-                            resp = self.server.query_router(index, pql, shards)
+                            with bypass:
+                                resp = self.server.query_router(
+                                    index, pql, shards
+                                )
                         except Exception as e:  # noqa: BLE001 — held for
                             # the flight recorder's settle decision
                             # (errored queries retain), re-raised below
                             # into _guarded's canonical status mapping
                             err = e
         elapsed = time.perf_counter() - t0
+        cache_out = (
+            cache.consume_outcome() if cache is not None else None
+        )
         prof.total_seconds = elapsed
         wait = getattr(self, "admission_wait_s", None)
         if wait is not None:
@@ -515,7 +535,18 @@ class Handler(BaseHTTPRequestHandler):
         if wl is not None and wl.enabled:
             fp, wl_call = wl.fingerprint(index, pql, shards)
             self._workload_fp = fp
-        self._flightrec_settle(index, pql, prof, elapsed, err, fp=fp, wl=wl)
+            if (
+                fp is not None
+                and cache_out is not None
+                and cache_out.get("outcome") == "hit"
+            ):
+                # measured hit next to the cachability estimate
+                # (/debug/workload servableFraction vs actualHitFraction)
+                wl.record_cache_hit(fp)
+        self._flightrec_settle(
+            index, pql, prof, elapsed, err, fp=fp, wl=wl,
+            cache_out=cache_out,
+        )
         if err is not None:
             self._workload_record(
                 wl, fp, wl_call, index, pql, prof, elapsed,
@@ -535,10 +566,15 @@ class Handler(BaseHTTPRequestHandler):
                     + f" ({worst['seconds']:.3f}s)"
                 )
             rank = wl.rank(fp) if wl is not None and fp is not None else None
+            cache_tag = (
+                f" cache={cache_out['outcome']}"
+                if cache_out is not None and "outcome" in cache_out
+                else ""
+            )
             self.server.log(
                 f"long query ({elapsed:.3f}s) index={index}"
-                f" trace={prof.trace_id} fp={fp} rank={rank}{where}:"
-                f" {pql[:200]}"
+                f" trace={prof.trace_id} fp={fp} rank={rank}{cache_tag}"
+                f"{where}: {pql[:200]}"
             )
         if proto:
             self._proto(encoding.protoser.response_to_bytes(resp))
@@ -591,6 +627,7 @@ class Handler(BaseHTTPRequestHandler):
     def _flightrec_settle(
         self, index: str, pql: str, prof, elapsed: float,
         err: BaseException | None, fp: str | None = None, wl=None,
+        cache_out: dict | None = None,
     ) -> None:
         """Hand the settled query to the flight recorder — the evidence
         thunk (full profile + the trace's buffered spans) is only paid
@@ -619,6 +656,11 @@ class Handler(BaseHTTPRequestHandler):
                     else []
                 ),
             }
+            if cache_out is not None:
+                # result-cache verdict for this serve (hit/miss/skip +
+                # fill outcome) — a retained slow query answers "why
+                # wasn't this a cache hit" directly
+                out["resultCache"] = cache_out
             if fp is not None:
                 out["fingerprint"] = fp
                 if wl is not None:
@@ -638,6 +680,54 @@ class Handler(BaseHTTPRequestHandler):
             return out
 
         rec.settle(call_type, elapsed, entry, error=err)
+
+    def _enrich_cache_candidacy(
+        self, plan: dict, index: str, pql: str,
+        shards: list[int] | None,
+    ) -> None:
+        """Add the MEASURED half of the EXPLAIN cache verdict: the
+        structural candidacy (api.explain) knows the thresholds, the
+        workload plane knows this fingerprint's measured cost and
+        result size — an admitted-in-principle query whose measured
+        mean cost sits below result-cache-min-cost-ms (or whose results
+        exceed the per-entry byte cap) reports skipped, with why."""
+        verdict = plan.get("resultCache")
+        cache = getattr(self.api, "result_cache", None)
+        wl = getattr(self.server, "workload", None)
+        if (
+            verdict is None
+            or cache is None
+            or wl is None
+            or not wl.enabled
+            or not verdict.get("admitted")
+        ):
+            return
+        fp, _ = wl.fingerprint(index, pql, shards)
+        with wl._lock:
+            st = wl._fp_stats.get(fp)
+            measured = st.to_json() if st is not None else None
+        if measured is None:
+            return
+        verdict["fingerprint"] = fp
+        verdict["measuredMeanMs"] = measured["meanMs"]
+        mean_bytes = measured["resultBytesTotal"] / max(
+            1, measured["observed"]
+        )
+        verdict["measuredMeanBytes"] = round(mean_bytes, 1)
+        if measured["meanMs"] < cache.min_cost_ms:
+            verdict["admitted"] = False
+            verdict["reason"] = (
+                f"measured mean cost {measured['meanMs']}ms is below "
+                f"result-cache-min-cost-ms ({cache.min_cost_ms}ms) — "
+                "not worth a ledger slot"
+            )
+        elif 0 < cache.entry_byte_cap < mean_bytes:
+            verdict["admitted"] = False
+            verdict["reason"] = (
+                f"measured mean result size {round(mean_bytes)} bytes "
+                f"exceeds the per-entry byte cap "
+                f"({cache.entry_byte_cap} bytes)"
+            )
 
     @staticmethod
     def _merge_explain_actuals(plan: dict, prof) -> dict:
@@ -885,6 +975,11 @@ class Handler(BaseHTTPRequestHandler):
         out["workload"] = snapshot_envelope(
             self.server.workload.vars_snapshot()
         )
+        # mutation-stamped result cache: ledger, hit/miss/eviction/
+        # invalidation counters, admission skips (docs/result-cache.md)
+        cache = getattr(self.api, "result_cache", None)
+        if cache is not None:
+            out["resultCache"] = snapshot_envelope(cache.snapshot())
         self._json(out)
 
     def h_debug_index(self) -> None:
@@ -1050,6 +1145,24 @@ class Handler(BaseHTTPRequestHandler):
                 ws["captureRingCapacity"], "entries", enabled=ws["enabled"])
             row("workloadSpill", ws["spillSegments"], None, "segments",
                 pendingRecords=ws["spillPendingRecords"])
+        # result-cache byte ledger (docs/result-cache.md): used vs the
+        # result-cache-bytes budget; the row() helper publishes the
+        # resource_bytes{subsystem="result-cache"} gauge alongside
+        cache = getattr(self.api, "result_cache", None)
+        if cache is not None:
+            cs = cache.snapshot()
+            row(
+                "result-cache",
+                cs["usedBytes"],
+                cs["maxBytes"] or None,
+                "bytes",
+                entries=cs["entries"],
+                hits=cs["hits"],
+                misses=cs["misses"],
+                evictions=cs["evictions"],
+                invalidations=cs["invalidations"],
+                mode=cs["mode"],
+            )
         row("tracerRing", GLOBAL_TRACER.depth(), MAX_SPANS, "spans")
         # serving front end: connections + per-class worker occupancy
         serving = self.server.serving_snapshot()
@@ -1350,6 +1463,17 @@ class _ServerCore:
         self.workload = WorkloadPlane(
             stats=self.stats, log=lambda msg: self.log(msg)
         )
+        # mutation-stamped cross-request result cache (docs/result-
+        # cache.md): default-constructed like the flight recorder so
+        # embedded/standalone listeners serve repeats from settled
+        # results too; Server.open replaces it with the config-sized
+        # one.  Attached to the API façade — consult/fill live in
+        # API.query, the cluster coordinator consults before fan-out.
+        from pilosa_tpu.utils.resultcache import ResultCache
+
+        self.result_cache = ResultCache(stats=self.stats)
+        api.result_cache = self.result_cache
+        self.workload.cache_byte_cap = self.result_cache.entry_byte_cap
         # continuous sampling profiler (docs/profiling.md): Server.open
         # installs a config-sized, STARTED SamplingProfiler; embedded/
         # standalone listeners leave it None (/debug/profile 404s) —

@@ -211,6 +211,22 @@ class Server:
             stats=self.stats,
             log=self.logger.log,
         )
+        # config-sized result cache (docs/result-cache.md) replaces the
+        # listener's default one; the cache's per-entry byte cap feeds
+        # the workload plane's cachability estimator so repeats of
+        # never-admittable giant results stop counting as servable
+        from pilosa_tpu.utils.resultcache import ResultCache
+
+        self.http.result_cache = ResultCache(
+            max_bytes=self.config.result_cache_bytes,
+            min_cost_ms=self.config.result_cache_min_cost_ms,
+            mode=self.config.result_cache_mode,
+            stats=self.stats,
+        )
+        self.api.result_cache = self.http.result_cache
+        self.http.workload.cache_byte_cap = (
+            self.http.result_cache.entry_byte_cap
+        )
         # continuous profiling + saturation plane (docs/profiling.md):
         # the config-sized sampler replaces the listener's None slot and
         # STARTS here — a flame graph of the last minute is one curl

@@ -243,6 +243,17 @@ class Config:
     workload_spill_max_age_s: float = 60.0
     # spill segments retained on disk (oldest deleted past the cap)
     workload_spill_segments: int = 8
+    # mutation-stamped cross-request result cache (docs/result-cache.md):
+    # byte budget for retained settled results (0 disables the cache —
+    # equivalent to result-cache-mode = "off")
+    result_cache_bytes: int = 64_000_000
+    # admission threshold: results whose measured execution cost is
+    # below this are not cached (the 0.2ms Count is cheaper to recompute
+    # than to ledger)
+    result_cache_min_cost_ms: float = 1.0
+    # "on" serves repeated reads from settled results; "off" makes the
+    # cache fully inert (the bench's cache-off baseline)
+    result_cache_mode: str = "on"
     # SLO objectives (docs/workload.md grammar), comma/semicolon-
     # separated: "<call>:p95<50ms:99.9" (99.9% of <call> queries settle
     # OK within 50ms) or "<call>:errors:99.9" (availability only);
@@ -402,6 +413,9 @@ def config_template() -> str:
         "workload-spill-max-bytes = 4000000\n"
         "workload-spill-max-age-s = 60.0\n"
         "workload-spill-segments = 8\n"
+        "result-cache-bytes = 64000000\n"
+        "result-cache-min-cost-ms = 1.0\n"
+        'result-cache-mode = "on"\n'
         'slo-targets = ""\n'
         'access-log-format = ""\n'
         'metric-service = "prometheus"\n'

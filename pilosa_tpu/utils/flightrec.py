@@ -215,6 +215,11 @@ class FlightRecorder:
                         # one /debug/workload lookup away
                         "fingerprint": entry.get("fingerprint"),
                         "workloadRank": entry.get("workloadRank"),
+                        # result-cache verdict (docs/result-cache.md):
+                        # "why wasn't this slow query a cache hit"
+                        "cache": (entry.get("resultCache") or {}).get(
+                            "outcome"
+                        ),
                     }
                 )
             )
@@ -245,22 +250,31 @@ class FlightRecorder:
             }
         summaries = [
             {
-                k: e.get(k)
-                for k in (
-                    "seq",
-                    "traceId",
-                    "index",
-                    "callType",
-                    "reason",
-                    "seconds",
-                    "thresholdSeconds",
-                    "error",
-                    "recordedAt",
-                    "query",
-                    "fingerprint",
-                    "workloadRank",
-                )
-                if e.get(k) is not None
+                **{
+                    k: e.get(k)
+                    for k in (
+                        "seq",
+                        "traceId",
+                        "index",
+                        "callType",
+                        "reason",
+                        "seconds",
+                        "thresholdSeconds",
+                        "error",
+                        "recordedAt",
+                        "query",
+                        "fingerprint",
+                        "workloadRank",
+                    )
+                    if e.get(k) is not None
+                },
+                # compact result-cache verdict; the full dict (fill
+                # outcome, skip reason) stays behind ?trace_id=
+                **(
+                    {"cache": e["resultCache"].get("outcome")}
+                    if e.get("resultCache")
+                    else {}
+                ),
             }
             for e in reversed(entries)
         ]

@@ -211,7 +211,7 @@ class _FpStats:
 
     __slots__ = (
         "index", "call", "example", "count", "errors", "bytes_total",
-        "hist", "last_stamp", "unchanged_repeats",
+        "hist", "last_stamp", "unchanged_repeats", "cache_hits",
     )
 
     def __init__(self, index: str, call: str, example: str):
@@ -224,11 +224,23 @@ class _FpStats:
         self.hist = Histogram()
         self.last_stamp = None
         self.unchanged_repeats = 0
+        self.cache_hits = 0
 
-    def observe(self, seconds: float, nbytes: int, error: bool, stamp) -> None:
-        if self.count > 0 and stamp is not None and stamp == self.last_stamp:
+    def observe(
+        self, seconds: float, nbytes: int, error: bool, stamp,
+        byte_cap: int = 0,
+    ) -> None:
+        if (
+            self.count > 0
+            and stamp is not None
+            and stamp == self.last_stamp
+            and not (byte_cap > 0 and nbytes > byte_cap)
+        ):
             # a repeat under an unchanged mutation stamp: the query a
-            # stamped result cache would have served from cache
+            # stamped result cache would have served from cache.
+            # Results over the cache's per-entry byte cap are excluded
+            # — they would never be admitted, and counting them
+            # overstated servable QPS for giant results
             self.unchanged_repeats += 1
         self.last_stamp = stamp
         self.count += 1
@@ -252,6 +264,12 @@ class _FpStats:
             "p95Ms": round(snap["p95"] * 1e3, 3),
             "repeats": max(0, self.count - 1),
             "repeatsUnchangedStamp": self.unchanged_repeats,
+            # MEASURED cache serves vs the estimate above — estimator
+            # drift reads directly off this pair (docs/result-cache.md)
+            "cacheHits": self.cache_hits,
+            "actualHitFraction": round(
+                self.cache_hits / max(1, self.count), 4
+            ),
             "stampChurn": round(
                 1.0
                 - self.unchanged_repeats / max(1, self.count - 1), 4
@@ -611,6 +629,13 @@ class WorkloadPlane:
         self._clock = clock
         self.fingerprints = Fingerprinter()
         self.sketch = SpaceSaving(top_k)
+        # the result cache's per-entry byte cap (wired by Server.open):
+        # repeats whose results exceed it are NOT servable and must not
+        # inflate the cachability estimate; 0 = no cap known
+        self.cache_byte_cap = 0
+        # aggregate measured cache serves (per-fingerprint counts live
+        # on _FpStats; this counts hits for evicted/untracked fps too)
+        self.cache_hits = 0
         self.slo = SLOEngine(slo_targets, stats=stats, clock=clock)
         self._lock = threading.Lock()
         self._ring: deque[dict] = deque(maxlen=self.capacity)
@@ -667,6 +692,7 @@ class WorkloadPlane:
         stamp=None,
         arrival: float | None = None,
         shards: list[int] | None = None,
+        spill: bool = True,
     ) -> None:
         """One settled public query.  ``stamp`` is the index's current
         view-version mutation stamp (API.mutation_stamp) — the
@@ -674,7 +700,10 @@ class WorkloadPlane:
         time (event front end), so replay spacing reflects offered
         load, not completion times; ``shards`` the request's explicit
         shard scope (part of the fingerprint identity — replay must
-        re-issue the same scope, not an all-shards variant)."""
+        re-issue the same scope, not an all-shards variant).
+        ``spill=False`` skips the durable spill file alone (the event
+        loop settles cache hits on the loop thread, where file I/O has
+        no place); the ring, sketch, and stats always observe."""
         if not self.enabled:
             return
         error = status >= 400
@@ -695,7 +724,10 @@ class WorkloadPlane:
                 st = self._fp_stats[fp] = _FpStats(
                     index, call_type, pql[:_MAX_PQL]
                 )
-            st.observe(seconds, nbytes, error, stamp)
+            st.observe(
+                seconds, nbytes, error, stamp,
+                byte_cap=self.cache_byte_cap,
+            )
             take = self._sample_every > 0 and (n % self._sample_every == 0)
             if not take:
                 self.dropped += 1
@@ -723,8 +755,20 @@ class WorkloadPlane:
             self.stats.count("workload_observed_total")
             if rec is not None:
                 self.stats.count("workload_sampled_total")
-        if rec is not None and self.capture_path is not None:
+        if rec is not None and spill and self.capture_path is not None:
             self._spill(rec)
+
+    def record_cache_hit(self, fp: str) -> None:
+        """One result-cache serve for this fingerprint — the MEASURED
+        half of the estimate-vs-actual pair /debug/workload reports
+        (``servableFraction`` vs ``actualHitFraction``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self.cache_hits += 1
+            st = self._fp_stats.get(fp)
+            if st is not None:
+                st.cache_hits += 1
 
     # -------------------------------------------------------------- spill
     def _spill(self, rec: dict) -> None:
@@ -813,9 +857,11 @@ class WorkloadPlane:
         with self._lock:
             observed = self.observed
             fp_stats = dict(self._fp_stats)
+            cache_hits = self.cache_hits
         entries = []
         servable = 0
         tracked_observed = 0
+        tracked_hits = 0
         for i, (fp, count, err) in enumerate(self.sketch.top(top)):
             st = fp_stats.get(fp)
             entry = {
@@ -830,6 +876,7 @@ class WorkloadPlane:
         for st in fp_stats.values():
             servable += st.unchanged_repeats
             tracked_observed += st.count
+            tracked_hits += st.cache_hits
         return {
             "enabled": self.enabled,
             "observed": observed,
@@ -847,6 +894,16 @@ class WorkloadPlane:
                     servable / max(1, tracked_observed), 4
                 ),
                 "servableQps": round(servable / elapsed, 3),
+                # the MEASURED result-cache serves next to the estimate
+                # above — estimator drift is the gap between these
+                # (docs/result-cache.md); actualHits counts every hit,
+                # actualHitFraction only tracked fingerprints so it is
+                # comparable to servableFraction
+                "actualHits": cache_hits,
+                "actualHitFraction": round(
+                    tracked_hits / max(1, tracked_observed), 4
+                ),
+                "cacheByteCap": self.cache_byte_cap or None,
             },
             "slo": {"enabled": self.slo.enabled},
         }

@@ -104,6 +104,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "cache: mutation-stamped result-cache suite — key identity, "
+        "mutation-race bit-equivalence, invalidation reach, byte-budget "
+        "eviction, the event-loop hit fast path, coordinator hits "
+        "(tests/test_resultcache.py; runs in tier-1 — the marker exists "
+        "so `pytest -m cache` scopes to it)",
+    )
+    config.addinivalue_line(
+        "markers",
         "slow: long/large-scale scenarios excluded from the tier-1 run "
         "(`-m 'not slow'`), e.g. the 10k-concurrent-connection smoke test",
     )

@@ -1,0 +1,28 @@
+"""Seeded violations for the cacheinvariant rule: import_bits and
+delete_field apply writes without calling the invalidation hook, so
+cached results for the index survive the write."""
+
+
+class API:
+    def __init__(self, holder, cache):
+        self.holder = holder
+        self.result_cache = cache
+
+    def _invalidate_results(self, index):
+        cache = self.result_cache
+        if cache is not None:
+            cache.invalidate(index)
+
+    def query(self, index, pql, shards=None):
+        wrote = self.holder.execute(index, pql, shards)
+        if wrote:
+            self._invalidate_results(index)
+        return {"results": []}
+
+    def import_bits(self, index, field, payload):
+        # BAD: the import acks without retiring cached results
+        self.holder.apply(index, field, payload)
+
+    def delete_field(self, index, field):
+        # BAD: results computed against the dropped field stay servable
+        self.holder.drop(index, field)

@@ -1,0 +1,36 @@
+"""Clean twin for the cacheinvariant rule: every write-path method
+reaches the result-cache invalidation hook, and the hook itself
+reaches cache.invalidate()."""
+
+
+class API:
+    def __init__(self, holder, cache):
+        self.holder = holder
+        self.result_cache = cache
+
+    def _invalidate_results(self, index):
+        cache = self.result_cache
+        if cache is not None:
+            cache.invalidate(index)
+
+    def query(self, index, pql, shards=None):
+        wrote = self.holder.execute(index, pql, shards)
+        if wrote:
+            self._invalidate_results(index)
+        return {"results": []}
+
+    def import_bits(self, index, field, payload):
+        self.holder.apply(index, field, payload)
+        self._invalidate_results(index)
+
+    def translate_keys(self, index, keys):
+        created = self.holder.translate(index, keys)
+        if created:
+            # key creation moves no mutation stamp — the hook is the
+            # only thing retiring results keyed under the old bindings
+            self._invalidate_results(index)
+        return created
+
+    def delete_field(self, index, field):
+        self.holder.drop(index, field)
+        self._invalidate_results(index)

@@ -77,6 +77,7 @@ def test_rule_registry_complete():
         "resilience",
         "asyncpurity",
         "durability",
+        "cacheinvariant",
     ):
         assert name in out, f"rule {name} missing from registry"
 
@@ -785,6 +786,61 @@ def test_metric_drift_covers_workload_families(tree_copy):
     assert rc != 0
     assert "slo_burn_rate" in out
     assert "workload_observed_total" in out
+
+
+def test_cacheinvariant_fixture_ok():
+    root = FIXTURES / "cacheinvariant_ok"
+    rc, out = run_analyzer(str(root / "server"), "--root", str(root))
+    assert rc == 0, out
+
+
+def test_cacheinvariant_fixture_bad():
+    root = FIXTURES / "cacheinvariant_bad"
+    rc, out = run_analyzer(str(root / "server"), "--root", str(root))
+    assert rc != 0
+    assert "[cacheinvariant]" in out
+    assert "import_bits" in out and "delete_field" in out
+
+
+def test_cacheinvariant_dropped_api_hook_fails(tree_copy):
+    # strip the hook call from every API write path: each import/DDL
+    # method now acks without retiring cached results — the exact
+    # stale-serve the rule exists to prevent
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "api.py",
+        "self._invalidate_results(",
+        "self._invalidate_nothing(",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[cacheinvariant]" in out
+    assert "import_roaring" in out and "apply_schema" in out
+
+
+def test_cacheinvariant_dropped_cluster_attr_hook_fails(tree_copy):
+    # the replica-side attr-set receiver is stamp-blind: dropping its
+    # hook leaves NO mechanism retiring that replica's cached results
+    mutate(
+        tree_copy / "pilosa_tpu" / "parallel" / "cluster.py",
+        'self.server.api._invalidate_results(payload["index"])',
+        'self.server.api._note_attr_write(payload["index"])',
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[cacheinvariant]" in out and "_apply_attr_write" in out
+
+
+def test_cacheinvariant_noop_hook_fails(tree_copy):
+    # a hook that stops reaching cache.invalidate() greens every write
+    # path while retiring nothing — the rule must see through it
+    mutate(
+        tree_copy / "pilosa_tpu" / "server" / "api.py",
+        "cache.invalidate(index)",
+        "cache.touch(index)",
+    )
+    rc, out = check_tree(tree_copy)
+    assert rc != 0
+    assert "[cacheinvariant]" in out and "no-op" in out
 
 
 def test_metric_drift_stale_doc_row_fails(tree_copy):

@@ -45,6 +45,9 @@ def make_cluster(tmp_path, n=3, replica_n=1, start=None):
             replica_n=replica_n,
             anti_entropy_interval=0,
             coordinator=(i == 0),
+            # routing tests assert where repeated identical reads land;
+            # a result-cache hit would (correctly) skip the fan-out
+            result_cache_mode="off",
         )
         s = Server(cfg)
         s.open()

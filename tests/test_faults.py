@@ -52,6 +52,9 @@ def free_ports(n):
 
 
 def make_cluster(tmp_path, n=2, replica_n=1, **extra):
+    # fault tests count fan-out RPCs of repeated identical reads; a
+    # result-cache hit would (correctly) skip the fan-out entirely
+    extra.setdefault("result_cache_mode", "off")
     ports = free_ports(n)
     seeds = [f"http://127.0.0.1:{p}" for p in ports]
     servers = []

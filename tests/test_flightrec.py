@@ -555,6 +555,9 @@ class TestTracePropagation:
 
 # ------------------------------------------------- 2-node acceptance e2e
 def _make_cluster(tmp_path, n=2, **extra):
+    # slow-query scenarios repeat one query under injected delay; a
+    # result-cache hit would serve it fast and never look slow
+    extra.setdefault("result_cache_mode", "off")
     ports = free_ports(n)
     seeds = [f"http://127.0.0.1:{p}" for p in ports]
     servers = []

@@ -1,0 +1,493 @@
+"""Mutation-stamped cross-query result cache (docs/result-cache.md).
+
+Pillars:
+- identity: a repeated read under an unchanged stamp serves the exact
+  settled response; scoped (`?shards=`) keys never cross-serve;
+- correctness under mutation: bit-equivalence with interleaved writes
+  (the test_scheduler dedup-race shape — a fill raced by a write is
+  keyed under the pre-write stamp, hence unreachable), read-your-writes
+  across a hit, fill-generation refusal when an invalidation overlaps
+  execution, and attribute writes invalidating despite an unmoved stamp;
+- bounded memory: per-entry byte cap, LRU eviction against the byte
+  budget with exact ledger accounting, the churn admission guard, and
+  the revalidate-every-N countdown;
+- serving: an event-loop hit occupies zero worker-pool slots; a 2-node
+  coordinator hit spends zero remote legs; a bystander node's cache is
+  retired by the write-path invalidation broadcast;
+- inertness: `result-cache-mode = "off"` changes nothing.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.executor.scheduler import dedup_key, stack_token
+from pilosa_tpu.server.api import API
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import resultcache
+from pilosa_tpu.utils.resultcache import ResultCache
+
+pytestmark = pytest.mark.cache
+
+
+# ------------------------------------------------------------ single-node
+def make_api(min_cost_ms=0.0, mode="on", max_bytes=64_000_000):
+    """Bare API façade over an in-memory holder, cache installed the
+    way the serving front ends do it."""
+    rng = np.random.default_rng(7)
+    h = Holder(None)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    n = 2000
+    cols = rng.integers(0, 2 * SHARD_WIDTH, n).astype(np.uint64)
+    f.import_bulk(rng.integers(0, 5, n).astype(np.uint64), cols)
+    idx.mark_columns_exist(cols)
+    api = API(h)
+    api.result_cache = ResultCache(
+        max_bytes=max_bytes, min_cost_ms=min_cost_ms, mode=mode
+    )
+    return h, idx, f, api
+
+
+def test_repeat_serves_identical_response():
+    _h, _idx, _f, api = make_api()
+    pql = "Count(Row(f=1))"
+    first = api.query("i", pql)
+    second = api.query("i", pql)
+    assert second == first
+    snap = api.result_cache.snapshot()
+    assert snap["hits"] == 1 and snap["fills"] == 1
+    assert snap["usedBytes"] > 0 and snap["entries"] == 1
+
+
+def test_read_your_writes_across_a_hit():
+    _h, idx, _f, api = make_api()
+    pql = "Count(Row(f=1))"
+    before = api.query("i", pql)["results"][0]
+    assert api.query("i", pql)["results"][0] == before  # hit
+    free = int(max(idx.available_shards(), default=0) + 3) * SHARD_WIDTH + 11
+    api.query("i", f"Set({free}, f=1)")
+    after = api.query("i", pql)["results"][0]
+    assert after == before + 1, "a hit must never mask a completed write"
+
+
+def test_interleaved_write_mutation_race_bit_equivalence():
+    """The dedup-race shape from test_scheduler: a write lands while a
+    read executes.  The settled fill is keyed under the PRE-write stamp,
+    so the post-write lookup computes a different key and re-executes —
+    the cached path must stay bit-identical to a bypassed execution."""
+    _h, idx, f, api = make_api()
+    pql = "Count(Row(f=1))"
+    entered, gate = threading.Event(), threading.Event()
+    real = api.scheduler.execute
+
+    def gated(index, calls, shards=None, **kw):
+        entered.set()
+        assert gate.wait(10)
+        return real(index, calls, shards=shards, **kw)
+
+    api.scheduler.execute = gated
+    out: dict = {}
+    t = threading.Thread(
+        target=lambda: out.update(r=api.query("i", pql)), daemon=True
+    )
+    t.start()
+    assert entered.wait(10)
+    token_before = stack_token(idx)
+    free_col = np.uint64(9 * SHARD_WIDTH + 1)
+    f.set_bit(1, free_col)  # the interleaved write: stamp moves
+    idx.mark_columns_exist(np.array([free_col], dtype=np.uint64))
+    assert stack_token(idx) != token_before
+    gate.set()
+    t.join(10)
+    api.scheduler.execute = real
+    with api.result_cache.bypass():
+        truth = api.query("i", pql)
+    assert api.query("i", pql) == truth
+    # the raced fill (if admitted at all) sits under the old stamp: the
+    # current key must not be a pre-write resurrection
+    key = dedup_key("i", __import__("pilosa_tpu.pql", fromlist=["parse"]).parse(pql), None, idx)
+    assert not api.result_cache.contains(key) or (
+        api.result_cache.get(key).resp == truth
+    )
+
+
+def test_fill_refused_when_invalidation_overlaps_execution():
+    """An invalidation landing mid-execution (the attr-write race the
+    stamp cannot see) must refuse the overlapping fill."""
+    _h, _idx, _f, api = make_api()
+    pql = "Count(Row(f=2))"
+    entered, gate = threading.Event(), threading.Event()
+    real = api.scheduler.execute
+
+    def gated(index, calls, shards=None, **kw):
+        entered.set()
+        assert gate.wait(10)
+        return real(index, calls, shards=shards, **kw)
+
+    api.scheduler.execute = gated
+    t = threading.Thread(target=lambda: api.query("i", pql), daemon=True)
+    t.start()
+    assert entered.wait(10)
+    api._invalidate_results("i")  # what SetRowAttrs reaches mid-flight
+    gate.set()
+    t.join(10)
+    api.scheduler.execute = real
+    snap = api.result_cache.snapshot()
+    assert snap["admissionSkips"].get("invalidated-during-execution", 0) >= 1
+    assert snap["entries"] == 0
+
+
+def test_attr_write_invalidates_despite_unmoved_stamp():
+    _h, idx, _f, api = make_api()
+    api.query("i", "Row(f=1)")
+    assert api.result_cache.snapshot()["entries"] == 1
+    token = stack_token(idx)
+    api.query("i", 'SetRowAttrs(f, 1, color="red")')
+    # attribute stores are outside the view-version stamp…
+    assert stack_token(idx) == token
+    # …so the hook is the only thing retiring the entry — and it must
+    snap = api.result_cache.snapshot()
+    assert snap["entries"] == 0 and snap["invalidations"] >= 1
+
+
+def test_shards_scoped_keys_never_cross_serve():
+    h = Holder(None)
+    idx = h.create_index("i")
+    f = idx.create_field("f")
+    s0 = np.array([1, 2, 3], dtype=np.uint64)
+    s1 = np.array([SHARD_WIDTH + 1, SHARD_WIDTH + 2, SHARD_WIDTH + 3,
+                   SHARD_WIDTH + 4, SHARD_WIDTH + 5], dtype=np.uint64)
+    f.import_bulk(np.ones(3, dtype=np.uint64), s0)
+    f.import_bulk(np.ones(5, dtype=np.uint64), s1)
+    idx.mark_columns_exist(np.concatenate([s0, s1]))
+    api = API(h)
+    api.result_cache = ResultCache(min_cost_ms=0.0)
+    pql = "Count(Row(f=1))"
+    want = {None: 8, (0,): 3, (1,): 5}
+    for scope, expect in want.items():
+        shards = list(scope) if scope is not None else None
+        assert api.query("i", pql, shards=shards)["results"][0] == expect
+    # second round: every scope hits — and hits its OWN entry
+    for scope, expect in want.items():
+        shards = list(scope) if scope is not None else None
+        assert api.query("i", pql, shards=shards)["results"][0] == expect
+    snap = api.result_cache.snapshot()
+    assert snap["hits"] == 3 and snap["entries"] == 3
+
+
+# --------------------------------------------------------------- admission
+def _key(i: int, stamp=(1, 1)) -> tuple:
+    return ("i", (f"Count(Row(f={i}))",), None, stamp)
+
+
+def _resp(i: int, pad: int = 0) -> dict:
+    return {"results": [i], "pad": "x" * pad}
+
+
+def _nbytes(resp: dict) -> int:
+    return len(json.dumps(resp, separators=(",", ":")).encode())
+
+
+def test_byte_budget_lru_eviction_and_exact_ledger():
+    c = ResultCache(max_bytes=1000, min_cost_ms=0.0)
+    sizes = {}
+    for i in range(40):
+        r = _resp(i, pad=40)
+        sizes[i] = _nbytes(r)
+        assert c.offer(_key(i), r, cost_s=0.01)
+        assert c.used_bytes <= c.max_bytes
+    resident = [i for i in range(40) if c.contains(_key(i))]
+    assert c.evictions == 40 - len(resident)
+    # LRU: the survivors are exactly the most recent fills
+    assert resident == list(range(40 - len(resident), 40))
+    assert c.used_bytes == sum(sizes[i] for i in resident)
+    # exact reclamation: invalidate drops everything for the index
+    c.invalidate("i")
+    assert c.used_bytes == 0 and c.snapshot()["entries"] == 0
+
+
+def test_entry_over_byte_cap_rejected():
+    c = ResultCache(max_bytes=1000, min_cost_ms=0.0)
+    assert c.entry_byte_cap == 125
+    assert not c.offer(_key(0), _resp(0, pad=500), cost_s=0.01)
+    assert c.snapshot()["admissionSkips"]["over-byte-cap"] == 1
+    assert c.used_bytes == 0
+
+
+def test_cost_below_threshold_rejected():
+    c = ResultCache(min_cost_ms=5.0)
+    assert not c.offer(_key(0), _resp(0), cost_s=0.001)
+    assert c.snapshot()["admissionSkips"]["cost-below-threshold"] == 1
+    assert c.offer(_key(0), _resp(0), cost_s=0.010)
+
+
+def test_churn_guard_pauses_write_dominated_index():
+    c = ResultCache(min_cost_ms=0.0)
+    for i in range(16):
+        c.offer(_key(0, stamp=(i, 1)), _resp(0), cost_s=0.01)
+    # 16 consecutive fills under a changed stamp: admission pauses
+    assert not c.offer(_key(0, stamp=(99, 1)), _resp(0), cost_s=0.01)
+    assert c.snapshot()["admissionSkips"]["stamp-churn"] >= 1
+    assert c.candidacy("i", has_write=False)["admitted"] is False
+    # the stamp holding still resumes admission
+    assert c.offer(_key(0, stamp=(99, 1)), _resp(0), cost_s=0.01)
+    assert c.candidacy("i", has_write=False)["admitted"] is True
+
+
+def test_revalidate_countdown_bounds_staleness(monkeypatch):
+    monkeypatch.setattr(resultcache, "REVALIDATE_HITS", 3)
+    c = ResultCache(min_cost_ms=0.0)
+    assert c.offer(_key(0), _resp(0), cost_s=0.01)
+    assert c.get(_key(0)) is not None
+    assert c.get(_key(0)) is not None
+    # third serve steps aside: one real execution re-verifies the entry
+    assert c.get(_key(0)) is None
+    assert c.revalidations == 1 and not c.contains(_key(0))
+
+
+def test_cache_off_is_inert():
+    for c in (ResultCache(mode="off"), ResultCache(max_bytes=0)):
+        assert not c.enabled
+        assert not c.offer(_key(0), _resp(0), cost_s=1.0)
+        assert c.get(_key(0)) is None
+        assert c.used_bytes == 0 and c.snapshot()["hits"] == 0
+    with pytest.raises(ValueError):
+        ResultCache(mode="auto")
+
+
+def test_bypass_skips_lookup_but_allows_fill():
+    _h, _idx, _f, api = make_api()
+    pql = "Count(Row(f=3))"
+    api.query("i", pql)
+    with api.result_cache.bypass():
+        api.query("i", pql)  # profiled run: must execute, not hit
+    snap = api.result_cache.snapshot()
+    assert snap["hits"] == 0
+    assert api.query("i", pql)["results"] is not None
+    assert api.result_cache.snapshot()["hits"] == 1
+
+
+# ------------------------------------------------------------- HTTP server
+from pilosa_tpu.server import Server  # noqa: E402
+from pilosa_tpu.utils.config import Config  # noqa: E402
+
+
+def make_server(tmp_path, **kw) -> Server:
+    cfg = Config(
+        bind="127.0.0.1:0",
+        data_dir=str(tmp_path / "data"),
+        anti_entropy_interval=0,
+        **kw,
+    )
+    s = Server(cfg)
+    s.open()
+    s.wait_mesh(30)
+    return s
+
+
+def call(port, method, path, body=None):
+    import urllib.request
+
+    data = (
+        body
+        if isinstance(body, (bytes, type(None)))
+        else json.dumps(body).encode()
+    )
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=data, method=method
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read() or b"{}")
+
+
+def test_event_loop_hit_zero_worker_occupancy(tmp_path):
+    s = make_server(tmp_path, result_cache_min_cost_ms=0.0)
+    try:
+        call(s.port, "POST", "/index/i", {})
+        call(s.port, "POST", "/index/i/field/f", {})
+        call(s.port, "POST", "/index/i/query", b"Set(1, f=1) Set(3, f=1)")
+        first = call(s.port, "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert first["results"] == [2]
+        # from here on, NOTHING may reach the worker pool
+        worker_calls = []
+        real = s.http._run_request
+
+        def counting(*a, **kw):
+            worker_calls.append(1)
+            return real(*a, **kw)
+
+        s.http._run_request = counting
+        conn = http.client.HTTPConnection("127.0.0.1", s.port, timeout=10)
+        try:
+            for _ in range(5):
+                conn.request(
+                    "POST", "/index/i/query", b"Count(Row(f=1))"
+                )
+                resp = conn.getresponse()
+                assert resp.status == 200
+                assert json.loads(resp.read()) == first
+        finally:
+            conn.close()
+            s.http._run_request = real
+        assert worker_calls == [], "a cache hit must never occupy a worker"
+        # the response is written before the settle step records stats —
+        # give the deferred settle of the last hit a beat to land
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            counters = s.stats.expvar()["counters"]
+            if counters.get("queries_served{path=cache}", 0) >= 5:
+                break
+            time.sleep(0.02)
+        assert counters.get("queries_served{path=cache}", 0) >= 5
+        v = call(s.port, "GET", "/debug/vars")
+        rc = v["resultCache"]
+        assert rc["hits"] >= 5 and rc["enabled"] is True
+        # the byte ledger row (tentpole criterion: /debug/resources)
+        res = call(s.port, "GET", "/debug/resources")
+        row = res["subsystems"]["result-cache"]
+        assert row["used"] == rc["usedBytes"] and row["used"] > 0
+        # satellite 1: measured hits next to the estimator
+        wl = call(s.port, "GET", "/debug/workload")
+        assert wl["cachability"]["actualHits"] >= 5
+        top = {e["examplePql"]: e for e in wl["topK"]}
+        hit_fp = top.get("Count(Row(f=1))")
+        assert hit_fp is not None and hit_fp["actualHitFraction"] > 0
+    finally:
+        s.close()
+
+
+def test_explain_reports_cache_candidacy(tmp_path):
+    s = make_server(tmp_path, result_cache_min_cost_ms=0.0)
+    try:
+        call(s.port, "POST", "/index/i", {})
+        call(s.port, "POST", "/index/i/field/f", {})
+        call(s.port, "POST", "/index/i/query", b"Set(1, f=1)")
+        plan = call(
+            s.port, "POST", "/index/i/query?explain=true", b"Count(Row(f=1))"
+        )["explain"]
+        rc = plan["resultCache"]
+        assert rc["enabled"] is True and rc["admitted"] is True
+        assert rc["cachedNow"] is False
+        call(s.port, "POST", "/index/i/query", b"Count(Row(f=1))")
+        plan = call(
+            s.port, "POST", "/index/i/query?explain=true", b"Count(Row(f=1))"
+        )["explain"]
+        assert plan["resultCache"]["cachedNow"] is True
+        # writes are never candidates
+        plan = call(
+            s.port, "POST", "/index/i/query?explain=true", b"Set(9, f=1)"
+        )["explain"]
+        assert plan["resultCache"]["admitted"] is False
+    finally:
+        s.close()
+
+
+def test_server_mode_off_is_inert(tmp_path):
+    s = make_server(
+        tmp_path, result_cache_mode="off", result_cache_min_cost_ms=0.0
+    )
+    try:
+        call(s.port, "POST", "/index/i", {})
+        call(s.port, "POST", "/index/i/field/f", {})
+        call(s.port, "POST", "/index/i/query", b"Set(1, f=1)")
+        for _ in range(3):
+            out = call(s.port, "POST", "/index/i/query", b"Count(Row(f=1))")
+            assert out["results"] == [1]
+        counters = s.stats.expvar()["counters"]
+        assert counters.get("queries_served{path=cache}", 0) == 0
+        rc = call(s.port, "GET", "/debug/vars")["resultCache"]
+        assert rc["enabled"] is False and rc["hits"] == 0 and rc["fills"] == 0
+    finally:
+        s.close()
+
+
+# ----------------------------------------------------------------- cluster
+def _free_ports(n):
+    import socket
+
+    socks = []
+    for _ in range(n):
+        sk = socket.socket()
+        sk.bind(("127.0.0.1", 0))
+        socks.append(sk)
+    ports = [sk.getsockname()[1] for sk in socks]
+    for sk in socks:
+        sk.close()
+    return ports
+
+
+def make_cluster(tmp_path, n=2):
+    ports = _free_ports(n)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+    servers = []
+    for i in range(n):
+        cfg = Config(
+            bind=f"127.0.0.1:{ports[i]}",
+            data_dir=str(tmp_path / f"node{i}"),
+            seeds=seeds,
+            replica_n=1,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+            result_cache_min_cost_ms=0.0,
+        )
+        s = Server(cfg)
+        s.open()
+        servers.append(s)
+    for s in servers:
+        if s.cluster is not None:
+            s.cluster._heartbeat_once()
+    return servers, ports
+
+
+def test_coordinator_hit_skips_fanout_and_broadcast_invalidates(tmp_path):
+    """2-node acceptance: a coordinator hit spends zero remote legs,
+    and a write acked by the OTHER node retires this node's cache (the
+    bystander's local stamp never moved — only the invalidation
+    broadcast keeps it honest), with bit-equivalent results throughout."""
+    servers, ports = make_cluster(tmp_path, n=2)
+    try:
+        call(ports[0], "POST", "/index/i", {})
+        call(ports[0], "POST", "/index/i/field/f", {})
+        cols = [s * SHARD_WIDTH + 3 for s in range(6)]
+        call(
+            ports[0],
+            "POST",
+            "/index/i/field/f/import",
+            {"rowIDs": [1] * 6, "columnIDs": cols},
+        )
+
+        def remote_legs(i):
+            c = servers[i].stats.expvar()["counters"]
+            return c.get("queries_served{path=remote}", 0)
+
+        first = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert first["results"] == [6]
+        legs_after_miss = remote_legs(1)
+        second = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert second == first
+        assert remote_legs(1) == legs_after_miss, (
+            "a coordinator cache hit must not fan out"
+        )
+        assert servers[0].http.result_cache.snapshot()["hits"] >= 1
+
+        # write through node 1: node 0 is a bystander for this ack —
+        # its stamp may not move, but the broadcast must retire its
+        # cached count before node 1's ack returns
+        free = 11 * SHARD_WIDTH + 7
+        call(ports[1], "POST", "/index/i/query", f"Set({free}, f=1)".encode())
+        third = call(ports[0], "POST", "/index/i/query", b"Count(Row(f=1))")
+        assert third["results"] == [7], (
+            "a remote write must be visible through the bystander's cache"
+        )
+        assert servers[0].http.result_cache.snapshot()["invalidations"] >= 1
+    finally:
+        for s in servers:
+            s.close()

@@ -696,6 +696,9 @@ def test_slo_burn_rate_flips_under_injected_delay(tmp_path):
             coordinator=(i == 0),
             heartbeat_interval=60.0,
             slo_targets="count:p95<500ms:99.9",
+            # the burn flip needs every repeat to re-execute through the
+            # delayed fan-out leg, not hit the result cache
+            result_cache_mode="off",
         )
         s = Server(cfg)
         s.open()

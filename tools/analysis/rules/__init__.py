@@ -4,6 +4,7 @@ the engine (tools.analysis.engine.get_rules)."""
 from tools.analysis.rules import (  # noqa: F401
     asyncpurity,
     banned,
+    cacheinvariant,
     configdrift,
     durability,
     locks,

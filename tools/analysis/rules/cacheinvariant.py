@@ -1,0 +1,160 @@
+"""Result-cache invalidation completeness (docs/result-cache.md).
+
+The mutation-stamped result cache retires entries two ways: the stamp
+part of the key (data writes bump the index view version, so the next
+lookup computes a different key) and the explicit write-path hook
+``API._invalidate_results``.  The hook is NOT redundancy — attribute
+writes and translate-key adoption move no stamp at all, so for them it
+is the only correctness mechanism, and for stamped writes it is what
+reclaims the dead entries' bytes.  A new write path that forgets the
+hook serves stale results silently — a failure mode no finite test
+matrix covers — so the reach is enforced structurally:
+
+1. **hook** — ``server/api.py``'s ``class API`` defines
+   ``_invalidate_results`` and that hook reaches a ``.invalidate(...)``
+   call on the cache (a no-op hook would green every path below while
+   retiring nothing);
+2. **API write paths** — every write-path method of ``class API``
+   (``REQUIRED_API``) calls ``_invalidate_results``;
+3. **cluster write paths** — ``parallel/cluster.py``'s ``class
+   Cluster`` applies writes that never pass through the API methods
+   above (remote query legs, the replica attr-set and translate-apply
+   receivers): each such method (``REQUIRED_CLUSTER``) must call
+   ``_invalidate_results`` too.
+
+Only methods actually PRESENT on the class are checked (mini fixture
+trees carry a subset), and files are located by project-relative
+suffix so the rule runs against mutated tree copies in tests.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import (
+    Project,
+    Violation,
+    call_name,
+    classdefs,
+    rule,
+)
+
+API = "server/api.py"
+CLUSTER = "parallel/cluster.py"
+HOOK = "_invalidate_results"
+
+# API methods that mutate index state a cached result could have read.
+REQUIRED_API = (
+    "query",
+    "import_bits",
+    "import_values",
+    "import_roaring",
+    "translate_keys",
+    "apply_schema",
+    "create_field",
+    "delete_field",
+    "delete_index",
+)
+
+# Cluster methods that apply writes locally without going through the
+# API write methods (scheduler-direct legs, replica-side receivers) —
+# plus the coordinator query path, whose write fan-out must retire the
+# coordinator's own cached results before the ack returns.
+REQUIRED_CLUSTER = (
+    "query",
+    "_h_query",
+    "_h_query_batch",
+    "_apply_attr_write",
+    "_h_translate_apply",
+)
+
+
+def _calls_in(node: ast.AST) -> set[str]:
+    return {
+        call_name(n.func)
+        for n in ast.walk(node)
+        if isinstance(n, ast.Call)
+    }
+
+
+def _has_call(node: ast.AST, *suffixes: str) -> bool:
+    calls = _calls_in(node)
+    return any(c.endswith(s) for c in calls for s in suffixes)
+
+
+def _methods(cls: ast.ClassDef) -> dict[str, ast.AST]:
+    return {
+        n.name: n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+
+def _check_class(
+    f, class_name: str, required: tuple[str, ...], expect_hook: bool
+) -> list[Violation]:
+    out: list[Violation] = []
+    cls = next(
+        (c for c in classdefs(f.tree) if c.name == class_name), None
+    )
+    if cls is None:
+        return out
+    methods = _methods(cls)
+    if expect_hook:
+        hook = methods.get(HOOK)
+        if hook is None:
+            out.append(
+                Violation(
+                    "cacheinvariant",
+                    f.rel,
+                    cls.lineno,
+                    f"class {class_name} defines no {HOOK}() hook — "
+                    "write paths have no way to retire cached results",
+                )
+            )
+        elif not _has_call(hook, ".invalidate"):
+            out.append(
+                Violation(
+                    "cacheinvariant",
+                    f.rel,
+                    hook.lineno,
+                    f"{HOOK}() never reaches cache.invalidate() — the "
+                    "hook is a no-op and every write path below it is "
+                    "silently stale-serving",
+                )
+            )
+    for name in required:
+        m = methods.get(name)
+        if m is None:
+            continue  # present-methods-only: mini fixture trees
+        if not _has_call(m, HOOK):
+            out.append(
+                Violation(
+                    "cacheinvariant",
+                    f.rel,
+                    m.lineno,
+                    f"{class_name}.{name}() is a write path but never "
+                    f"calls {HOOK} — result-cache entries for the index "
+                    "survive the write (attr/translate writes move no "
+                    "mutation stamp, so nothing else retires them)",
+                )
+            )
+    return out
+
+
+@rule(
+    "cacheinvariant",
+    "every API/cluster write path reaches the result-cache "
+    "invalidation hook",
+)
+def check_cacheinvariant(project: Project) -> list[Violation]:
+    out: list[Violation] = []
+    api = project.find(API)
+    if api is not None and api.tree is not None:
+        out.extend(_check_class(api, "API", REQUIRED_API, True))
+    cluster = project.find(CLUSTER)
+    if cluster is not None and cluster.tree is not None:
+        out.extend(
+            _check_class(cluster, "Cluster", REQUIRED_CLUSTER, False)
+        )
+    return out
