@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache
+.PHONY: check fix test analyze sanitize bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -18,6 +18,17 @@ analyze:
 # tier-1 test suite (see ROADMAP.md for the exact CI invocation)
 test:
 	JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow'
+
+# tier-1 under the runtime concurrency sanitizer (docs/concurrency.md):
+# every make_lock site instrumented, the observed holds-while-acquiring
+# graph checked against the analyzer's static closure; the conftest gate
+# fails the session on any cycle, loop-thread blocking acquire, or
+# observed edge the static graph did not predict
+sanitize:
+	python -m tools.analysis --emit-lock-graph pilosa_tpu > .sanitize-static.json
+	JAX_PLATFORMS=cpu PILOSA_TPU_SANITIZE=1 \
+		PILOSA_TPU_SANITIZE_STATIC=.sanitize-static.json \
+		python -m pytest tests/ -q -m 'not slow'
 
 # mixed ingest+read row, the wire-speed sustained bulk-lane row
 # (docs/ingest.md — exits non-zero below 10 M set-bits/s through the

@@ -40,7 +40,7 @@ _FRAGMENT_UIDS = itertools.count(1)
 from pilosa_tpu import roaring
 from pilosa_tpu.core.cache import NopCache, make_cache
 from pilosa_tpu.shardwidth import SHARD_WIDTH, WORDS_PER_SHARD
-from pilosa_tpu.utils import durable, saturation
+from pilosa_tpu.utils import durable, sanitize, saturation
 from pilosa_tpu.utils.log import Logger
 
 _LOG = Logger()  # stderr sink; recovery events must be loud
@@ -97,7 +97,10 @@ class Fragment:
         self.snapshot_bytes = 0
         # contention-counted (docs/profiling.md): every fragment's lock
         # folds into the "fragment" family in /debug/saturation
-        self._lock = saturation.ContendedLock("fragment", reentrant=True)
+        self._lock = sanitize.make_lock(
+            "Fragment._lock", reentrant=True,
+            inner=saturation.ContendedLock("fragment", reentrant=True),
+        )
         self._opened = False  # gates ops-log appends (see _append_op)
         # background compaction hand-off (core/compact.py), injected by
         # the owning View: when set, an over-threshold ops log queues a

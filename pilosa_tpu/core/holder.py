@@ -25,7 +25,7 @@ from concurrent.futures import ThreadPoolExecutor
 
 from pilosa_tpu.core.compact import Compactor
 from pilosa_tpu.core.index import Index, IndexOptions
-from pilosa_tpu.utils import saturation
+from pilosa_tpu.utils import sanitize, saturation
 
 
 class _LoadPool(ThreadPoolExecutor):
@@ -51,7 +51,9 @@ class Holder:
         self.indexes: dict[str, Index] = {}
         # contention-counted (docs/profiling.md): /debug/saturation's
         # "holder" lock family
-        self._create_lock = saturation.ContendedLock("holder")
+        self._create_lock = sanitize.make_lock(
+            "Holder._create_lock", inner=saturation.ContendedLock("holder")
+        )
         # parallel cold-start fragment loading; <=1 loads serially
         self.load_workers = load_workers
         # fragment-count floor below which open() loads serially even

@@ -16,7 +16,7 @@ import json
 import os
 import threading
 
-from pilosa_tpu.utils import durable
+from pilosa_tpu.utils import durable, sanitize
 from pilosa_tpu.utils.log import Logger
 
 _LOG = Logger()  # stderr sink; recovery events must be loud
@@ -25,7 +25,7 @@ _LOG = Logger()  # stderr sink; recovery events must be loud
 class TranslateStore:
     def __init__(self, path: str | None = None):
         self.path = path
-        self._lock = threading.RLock()
+        self._lock = sanitize.make_lock("TranslateStore._lock", reentrant=True)
         self._by_key: dict[str, int] = {}
         self._by_id: dict[int, str] = {}
         self._next_id = 1  # 0 is reserved (reference never allocates 0)

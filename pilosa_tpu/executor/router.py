@@ -48,6 +48,8 @@ from typing import Callable
 
 import numpy as np
 
+from pilosa_tpu.utils import sanitize
+
 from pilosa_tpu.core import FIELD_INT, VIEW_STANDARD
 from pilosa_tpu.pql import Call
 from pilosa_tpu.shardwidth import WORDS_PER_SHARD
@@ -90,7 +92,7 @@ class RouterAudit:
     def __init__(self, stats=None, enabled: bool = True, alpha: float = 0.1):
         self.stats = stats
         self.enabled = bool(enabled)
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("RouterAudit._lock")
         self._ratio_hists: dict[str, Histogram] = {}
         self._ratio_ewmas: dict[str, Ewma] = {}
         self._samples: dict[str, int] = {}
@@ -242,7 +244,7 @@ class QueryRouter:
         # traffic and batch-mode=off see the unamortized model unchanged.
         self.wave_occupancy = Ewma(alpha, 1.0)
         self.crossover_override = float(crossover_words)
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("QueryRouter._lock")
         self._memo: dict[tuple, tuple[int, str]] = {}
         self._gen = 0
         # drift baselines start at the seeds: the FIRST observation that

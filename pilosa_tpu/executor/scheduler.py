@@ -56,7 +56,7 @@ from pilosa_tpu.executor.executor import (
     unwrap_options,
 )
 from pilosa_tpu.pql import Call, parse
-from pilosa_tpu.utils import saturation, tracing
+from pilosa_tpu.utils import sanitize, saturation, tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 BATCH_MODES = ("off", "adaptive", "always")
@@ -223,7 +223,9 @@ class WaveScheduler:
         # "scheduler" lock family.  NOTE: Condition.wait's re-acquire
         # after notify counts as contention — that is real time a woken
         # wave-mate spends waiting for the queue lock, not noise.
-        self._lock = saturation.ContendedLock("scheduler")
+        self._lock = sanitize.make_lock(
+            "WaveScheduler._lock", inner=saturation.ContendedLock("scheduler")
+        )
         # one condition over the queue/leadership state: enqueues and
         # wave completions notify; waiting submitters contend to lead
         self._cond = threading.Condition(self._lock)

@@ -54,7 +54,7 @@ from pilosa_tpu.encoding import frame
 from pilosa_tpu.pql import Call, parse
 from pilosa_tpu.roaring import serialize
 from pilosa_tpu.shardwidth import SHARD_WIDTH
-from pilosa_tpu.utils import durable, tracing
+from pilosa_tpu.utils import durable, sanitize, tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 HEARTBEAT_INTERVAL = 2.0
@@ -116,7 +116,7 @@ class _NodeLegBatcher:
 
     def __init__(self, cluster: "Cluster"):
         self.cluster = cluster
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("_NodeLegBatcher._lock")
         self._cond = threading.Condition(self._lock)
         self._pending: dict[str, deque[_Leg]] = {}
         self._busy: set[str] = set()
@@ -347,7 +347,7 @@ class Cluster:
         # concurrent announces/imports would lose one side's update in a
         # get|set race, transiently breaking read-your-writes). Readers
         # stay lock-free: whole-set assignment is atomic.
-        self._shard_cache_lock = threading.Lock()
+        self._shard_cache_lock = sanitize.make_lock("Cluster._shard_cache_lock")
         # logical clock over announce applications: a heartbeat /status
         # snapshot is fetched at some clock reading c0, and an announce
         # for (node, index) stamped AFTER c0 proves the snapshot may
@@ -360,7 +360,7 @@ class Cluster:
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
-        self._import_exec_lock = threading.Lock()
+        self._import_exec_lock = sanitize.make_lock("Cluster._import_exec_lock")
         # bounded pool for the concurrent heartbeat /status sweep.
         # Created EAGERLY (threads only spawn on first submit, so this
         # is free) — lazy creation raced close(): a shutdown landing
@@ -384,7 +384,7 @@ class Cluster:
         self._translate_fence_ok = False
         self._translate_reconcile_pending = True
         self._observed_primary_id: str | None = None
-        self._translate_fence_lock = threading.Lock()
+        self._translate_fence_lock = sanitize.make_lock("Cluster._translate_fence_lock")
         # bumped (under the lock) on every observed primacy transition; a
         # fence that straddles a transition must not stamp itself valid
         self._primacy_gen = 0
@@ -395,7 +395,7 @@ class Cluster:
         # bound, skip the push, and ack an allocation no peer holds.
         # Every subsequent allocation on the store re-pushes these first.
         self._unpushed_translate: dict[tuple[str, str | None], dict[str, int]] = {}
-        self._unpushed_lock = threading.Lock()
+        self._unpushed_lock = sanitize.make_lock("Cluster._unpushed_lock")
 
     # ------------------------------------------------------------ membership
     @property

@@ -44,7 +44,7 @@ from concurrent.futures import ThreadPoolExecutor
 from pilosa_tpu import __version__
 from pilosa_tpu.parallel import resilience
 from pilosa_tpu.server.http import Handler, _ServerCore
-from pilosa_tpu.utils import StatsClient
+from pilosa_tpu.utils import StatsClient, sanitize
 
 # combined request-line + headers byte cap (http.server's _MAXLINE era
 # limit); past it the client gets 431 and the connection closes
@@ -265,9 +265,14 @@ class EventHTTPServer(_ServerCore):
         loop = asyncio.new_event_loop()
         asyncio.set_event_loop(loop)
         self._loop = loop
+        # under PILOSA_TPU_SANITIZE=1 every blocking acquire of a
+        # non-loop_safe lock on THIS thread becomes a finding — the
+        # runtime check behind the static loop-purity rule
+        sanitize.mark_loop_thread()
         try:
             loop.run_until_complete(self._serve())
         finally:
+            sanitize.unmark_loop_thread()
             try:
                 loop.run_until_complete(loop.shutdown_asyncgens())
             finally:
@@ -466,7 +471,9 @@ class EventHTTPServer(_ServerCore):
             # per-connection chokepoint: a handler bug must kill ONE
             # connection, never the accept loop
             self.stats.count("eventloop_unhandled_exceptions")
-            self.log(f"connection handler error: {e!r}")
+            # error path only: one bounded line to stderr under the
+            # logger lock, exceptional by construction
+            self.log(f"connection handler error: {e!r}")  # pilosa: allow(loop-purity)
         finally:
             if task is not None:
                 self._conn_tasks.discard(task)

@@ -72,6 +72,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
     ("GET", re.compile(r"^/debug/workload$"), "debug_workload"),
     ("GET", re.compile(r"^/debug/slo$"), "debug_slo"),
+    ("GET", re.compile(r"^/debug/sanitize$"), "debug_sanitize"),
     ("GET", re.compile(r"^/debug/faults$"), "debug_faults"),
     ("POST", re.compile(r"^/debug/faults$"), "debug_faults_set"),
     ("DELETE", re.compile(r"^/debug/faults$"), "debug_faults_clear"),
@@ -102,6 +103,7 @@ _DEBUG_ENDPOINTS: list[tuple[str, str, bool, str | None]] = [
     ("/debug/flightrec", "retained slow/errored query evidence (?trace_id=, &format=perfetto)", True, ""),
     ("/debug/workload", "heavy-hitter fingerprints + cachability estimate (?top=, ?format=capture)", True, ""),
     ("/debug/slo", "per-call-type SLO burn rates and budget remaining", True, ""),
+    ("/debug/sanitize", "concurrency sanitizer: observed lock graph, cycles, loop-thread findings (PILOSA_TPU_SANITIZE=1)", True, ""),
     ("/debug/faults", "armed fault-injection rules, RPC + filesystem (POST/DELETE to arm/clear)", True, ""),
     ("/debug/traces", "recent tracing spans (?trace_id=, ?format=chrome)", True, ""),
     ("/debug/pprof/profile", "BLOCKING on-demand sampling profile (?seconds=, default 5)", False, "?seconds=1"),
@@ -120,7 +122,7 @@ def snapshot_envelope(section: dict) -> dict:
     snapshot" had no uniform answer."""
     out = dict(section)
     out["snapshotMonotonicS"] = time.monotonic()
-    out["generatedAt"] = datetime.now(timezone.utc).isoformat()  # pilosa: allow(wall-clock)
+    out["generatedAt"] = datetime.now(timezone.utc).isoformat()
     return out
 
 
@@ -1270,6 +1272,18 @@ class Handler(BaseHTTPRequestHandler):
             return
         wl.slo.publish_gauges()
         self._json(wl.slo.snapshot())
+
+    def h_debug_sanitize(self) -> None:
+        """Concurrency-sanitizer report (docs/concurrency.md): the
+        observed holds-A-while-acquiring-B lock graph, per-lock hold
+        times, lock-order cycles, event-loop-thread blocking acquires,
+        and — when PILOSA_TPU_SANITIZE_STATIC points at the analyzer's
+        --emit-lock-graph output — observed edges the static call-graph
+        closure failed to predict.  Inert (enabled=false) unless the
+        process started with PILOSA_TPU_SANITIZE=1."""
+        from pilosa_tpu.utils import sanitize
+
+        self._json(sanitize.report())
 
     def h_debug_traces(self) -> None:
         """Recent spans, or one trace by id. ``?trace_id=`` filters to a

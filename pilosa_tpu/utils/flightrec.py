@@ -40,6 +40,7 @@ from typing import Callable
 
 import bisect
 
+from pilosa_tpu.utils import sanitize
 from pilosa_tpu.utils.stats import DEFAULT_BUCKETS, Histogram
 
 # observations per rolling window: the p95 threshold is computed over
@@ -63,7 +64,7 @@ class _RollingP95:
     def __init__(self):
         self.cur = Histogram()
         self.prev: Histogram | None = None
-        self._rotate_lock = threading.Lock()
+        self._rotate_lock = sanitize.make_lock("_RollingP95._rotate_lock", loop_safe=True)
 
     def observe(self, seconds: float) -> None:
         self.cur.observe(seconds)
@@ -115,7 +116,7 @@ class FlightRecorder:
         self.stats = stats
         self.log = log
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("FlightRecorder._lock", loop_safe=True)
         self._entries: deque[dict] = deque(maxlen=self.capacity)
         self._quantiles: dict[str, _RollingP95] = {}
         self._seq = 0
@@ -183,7 +184,7 @@ class FlightRecorder:
         entry["monotonicS"] = self._clock()
         # wall timestamp, never used in arithmetic — operators correlate
         # entries with external logs by it
-        entry["recordedAt"] = time.time()  # pilosa: allow(wall-clock)
+        entry["recordedAt"] = time.time()
         with self._lock:
             self._seq += 1
             entry["seq"] = self._seq

@@ -11,10 +11,12 @@ import sys
 import threading
 import time
 
+from pilosa_tpu.utils import sanitize
+
 
 class Logger:
     def __init__(self, path: str | None = None):
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("Logger._lock", loop_safe=True)
         self._file = open(path, "a") if path else None
 
     def log(self, msg: str) -> None:

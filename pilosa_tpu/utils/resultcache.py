@@ -47,6 +47,8 @@ from collections import OrderedDict
 from contextlib import contextmanager
 from typing import Any
 
+from pilosa_tpu.utils import sanitize
+
 # after this many hits an entry is deliberately served as a miss and
 # dropped, so the settle path re-executes and re-fills it — the route
 # cache's bounded revalidate-every-N idiom (executor/executor.py),
@@ -104,13 +106,15 @@ class _PqlKeyer:
 
     def __init__(self, capacity: int = 512):
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("_PqlKeyer._lock", loop_safe=True)
         self._memo: OrderedDict[str, tuple | None] = OrderedDict()
 
     def cached(self, pql: str):
         """The memoized canonical tuple, ``None`` (a write), or
         ``MISSING`` — never parses, safe on the event loop."""
-        with self._lock:
+        # loop_safe: O(1) LRU memo peek, nothing blocking under the
+        # lock; registered loop_safe with the sanitizer (make_lock)
+        with self._lock:  # pilosa: allow(loop-purity)
             if pql in self._memo:
                 self._memo.move_to_end(pql)
                 return self._memo[pql]
@@ -145,7 +149,7 @@ class ResultCache:
         self.min_cost_ms = float(min_cost_ms)
         self.mode = mode
         self.stats = stats
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("ResultCache._lock", loop_safe=True)
         self._entries: OrderedDict[tuple, _Entry] = OrderedDict()
         self._by_index: dict[str, set] = {}
         self._gen: dict[str, int] = {}
@@ -186,7 +190,9 @@ class ResultCache:
             # a real execution, never a cached serve
             self._set_outcome("skip", "bypass")
             return None
-        with self._lock:
+        # loop_safe: bounded LRU probe + counter bumps, nothing
+        # blocking under the lock; registered loop_safe (make_lock)
+        with self._lock:  # pilosa: allow(loop-purity)
             e = self._entries.get(key)
             if e is not None:
                 e.countdown -= 1

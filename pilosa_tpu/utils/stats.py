@@ -11,6 +11,8 @@ from __future__ import annotations
 import bisect
 import threading
 import time
+
+from pilosa_tpu.utils import sanitize
 from collections import defaultdict
 
 
@@ -138,7 +140,7 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)
         self.count = 0
         self.sum = 0.0
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("Histogram._lock", loop_safe=True)
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self.buckets, value)
@@ -206,7 +208,7 @@ class Histogram:
 class StatsClient:
     def __init__(self, prefix: str = "pilosa_tpu"):
         self.prefix = prefix
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("StatsClient._lock", loop_safe=True)
         self._counters: dict[tuple, float] = defaultdict(float)
         self._gauges: dict[tuple, float] = {}
         self._timings: dict[tuple, Histogram] = {}
@@ -499,7 +501,7 @@ class IngestMeter:
     WINDOW_S = 60.0
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("IngestMeter._lock")
         self.bytes_total = 0
         self.bits_total = 0
         self.posts_total = 0

@@ -57,6 +57,7 @@ import time
 from collections import deque
 from typing import Any, Callable
 
+from pilosa_tpu.utils import sanitize
 from pilosa_tpu.utils.stats import Histogram
 
 # ring records keep the raw PQL truncated to this many characters —
@@ -109,7 +110,7 @@ class Fingerprinter:
     path pays a dict hit, not a parse."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("Fingerprinter._lock", loop_safe=True)
         self._cache: dict[tuple, tuple[str, str]] = {}
 
     def fingerprint(
@@ -161,7 +162,7 @@ class SpaceSaving:
 
     def __init__(self, k: int = 64):
         self.k = max(1, int(k))
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("SpaceSaving._lock", loop_safe=True)
         # key -> [count, error]
         self._counters: dict[str, list[int]] = {}
         self.observed = 0
@@ -432,7 +433,7 @@ class SLOEngine:
         self.targets = targets
         self.stats = stats
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("SLOEngine._lock", loop_safe=True)
         # call (lowercased) -> target; "*" is the fallback
         self._by_call = {t.call: t for t in targets}
         # call -> {window_name: _BucketWindow}
@@ -637,7 +638,7 @@ class WorkloadPlane:
         # on _FpStats; this counts hits for evicted/untracked fps too)
         self.cache_hits = 0
         self.slo = SLOEngine(slo_targets, stats=stats, clock=clock)
-        self._lock = threading.Lock()
+        self._lock = sanitize.make_lock("WorkloadPlane._lock", loop_safe=True)
         self._ring: deque[dict] = deque(maxlen=self.capacity)
         self._fp_stats: dict[str, _FpStats] = {}
         self.observed = 0
@@ -764,7 +765,9 @@ class WorkloadPlane:
         (``servableFraction`` vs ``actualHitFraction``)."""
         if not self.enabled:
             return
-        with self._lock:
+        # loop_safe: two counter bumps, no I/O under the lock;
+        # registered loop_safe with the sanitizer (make_lock)
+        with self._lock:  # pilosa: allow(loop-purity)
             self.cache_hits += 1
             st = self._fp_stats.get(fp)
             if st is not None:

@@ -117,6 +117,30 @@ def pytest_configure(config):
     )
 
 
+def pytest_sessionfinish(session, exitstatus):
+    """Concurrency-sanitizer gate: when the suite ran under
+    PILOSA_TPU_SANITIZE=1, fail the session if the instrumented locks
+    observed a lock-order cycle, a blocking acquire of a non-loop_safe
+    lock on the event-loop thread, or (when PILOSA_TPU_SANITIZE_STATIC
+    points at --emit-lock-graph output) a holds-while-acquiring edge the
+    static call-graph closure failed to predict.  No-op otherwise."""
+    from pilosa_tpu.utils import sanitize
+
+    if not sanitize.enabled():
+        return
+    problems = sanitize.findings()
+    if not problems:
+        return
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    for line in problems:
+        msg = f"[pilosa-tpu sanitize] {line}"
+        if tr is not None:
+            tr.write_line(msg, red=True)
+        else:
+            print(msg)
+    session.exitstatus = 3
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)

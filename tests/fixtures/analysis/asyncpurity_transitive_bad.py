@@ -1,0 +1,15 @@
+"""Seeded transitive asyncpurity violation: the coroutine itself is
+clean — the blocking sleep hides one sync helper down, where only the
+call-graph walk finds it."""
+
+import time
+
+
+async def pump(queue):
+    while queue:
+        _drain(queue)
+
+
+def _drain(queue):
+    time.sleep(0.05)
+    queue.pop()

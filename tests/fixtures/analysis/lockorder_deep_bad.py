@@ -1,0 +1,34 @@
+"""Seeded deep lock-order cycle: each entry point holds one lock and
+takes the other TWO call frames down — the pre-call-graph one-level
+closure cannot see either edge, so only the whole-program fixpoint
+finds the AB/BA."""
+
+import threading
+
+
+class Coordinator:
+    def __init__(self):
+        self._plan_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def replan(self):
+        with self._plan_lock:
+            self._notify()
+
+    def _notify(self):
+        self._record()
+
+    def _record(self):
+        with self._stats_lock:
+            pass
+
+    def flush(self):
+        with self._stats_lock:
+            self._rebuild()
+
+    def _rebuild(self):
+        self._load()
+
+    def _load(self):
+        with self._plan_lock:
+            pass

@@ -1,0 +1,25 @@
+"""Clean twin: both entry points order _plan_lock before _stats_lock
+(still two frames deep), so the closed graph is a DAG."""
+
+import threading
+
+
+class Coordinator:
+    def __init__(self):
+        self._plan_lock = threading.Lock()
+        self._stats_lock = threading.Lock()
+
+    def replan(self):
+        with self._plan_lock:
+            self._notify()
+
+    def _notify(self):
+        self._record()
+
+    def _record(self):
+        with self._stats_lock:
+            pass
+
+    def flush(self):
+        with self._plan_lock:
+            self._notify()

@@ -1,0 +1,6 @@
+"""Stand-in parser module: any edge into pql/ from the loop is a
+loop-purity finding."""
+
+
+def parse_query(raw):
+    return {"calls": raw}

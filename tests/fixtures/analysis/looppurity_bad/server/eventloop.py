@@ -1,0 +1,28 @@
+"""Seeded loop-purity violations: the event loop's cache-hit fast
+path wanders into the parser, a blocking sleep, and an unannotated
+lock — each two helpers below the coroutine, so only the call-graph
+walk can see them."""
+
+import threading
+import time
+
+from pql.parser import parse_query
+
+
+class EventLoop:
+    def __init__(self):
+        self._table_lock = threading.Lock()
+
+    async def serve_cached(self, raw):
+        plan = self._plan(raw)
+        self._refresh(plan)
+        return plan
+
+    def _plan(self, raw):
+        # parser entry: cache hits must never pay a parse
+        return parse_query(raw)
+
+    def _refresh(self, key):
+        time.sleep(0.01)
+        with self._table_lock:
+            return key

@@ -1,0 +1,5 @@
+"""Stand-in parser module (clean twin)."""
+
+
+def parse_query(raw):
+    return {"calls": raw}

@@ -1,0 +1,28 @@
+"""Clean twin: the fast path touches only a loop-safe lock (site
+pragma with a reason), and the parse happens behind a pragma'd
+hand-off edge — the worker pool runs it, not the loop thread."""
+
+import threading
+
+from pql.parser import parse_query
+
+
+class EventLoop:
+    def __init__(self):
+        self._stats_lock = threading.Lock()
+
+    async def serve_cached(self, raw):
+        hit = self._lookup(raw)
+        if hit is not None:
+            return hit
+        # miss: parsing happens on the worker pool via run_in_executor
+        # in the real tree — this edge never runs on the loop thread
+        return self._dispatch(raw)  # pilosa: allow(loop-purity)
+
+    def _lookup(self, raw):
+        # bounded LRU peek; registered loop_safe with the sanitizer
+        with self._stats_lock:  # pilosa: allow(loop-purity)
+            return None
+
+    def _dispatch(self, raw):
+        return parse_query(raw)

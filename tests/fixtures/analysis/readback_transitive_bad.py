@@ -1,0 +1,15 @@
+"""Seeded transitive readback violation: the public entry is
+sync-free — the device→host coercion hides in a helper, and the rule
+must attribute the CALL edge, not just the terminal site."""
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def snapshot(state):
+    return {"total": _total(state)}
+
+
+def _total(state):
+    acc = jnp.sum(state)
+    return float(np.asarray(acc))

@@ -1,0 +1,19 @@
+"""Clean twin: the helper keeps the value on device (the executor's
+readback wave fetches it), and the one deliberate sync carries a site
+pragma — which also stops it from propagating to callers."""
+
+import jax.numpy as jnp
+
+
+def snapshot(state):
+    return {"total": _total(state), "hint": _size_hint(state)}
+
+
+def _total(state):
+    # stays on device: the readback wave fetches it
+    return jnp.sum(state)
+
+
+def _size_hint(state):
+    # startup-only shape probe, never on a query path
+    return int(jnp.asarray(state).size)  # pilosa: allow(readback)

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import contextlib
 import io
+import json
 import shutil
 import subprocess
 import sys
@@ -78,6 +79,7 @@ def test_rule_registry_complete():
         "asyncpurity",
         "durability",
         "cacheinvariant",
+        "loop-purity",
     ):
         assert name in out, f"rule {name} missing from registry"
 
@@ -96,6 +98,11 @@ def test_rule_registry_complete():
         ("asyncpurity_bad.py", ["asyncpurity"]),
         # lives under core/ so the holder-data-layer scope applies
         ("core/durability_bad.py", ["durability"]),
+        # transitive fixtures: the violation hides ≥1 call frame below
+        # the entry point — only the call-graph walk can reach it
+        ("asyncpurity_transitive_bad.py", ["asyncpurity"]),
+        ("readback_transitive_bad.py", ["readback"]),
+        ("lockorder_deep_bad.py", ["lock-order"]),
     ],
 )
 def test_seeded_fixture_fails(fixture, rules):
@@ -114,6 +121,9 @@ def test_seeded_fixture_fails(fixture, rules):
         "resilience_ok.py",
         "asyncpurity_ok.py",
         "core/durability_ok.py",
+        "asyncpurity_transitive_ok.py",
+        "readback_transitive_ok.py",
+        "lockorder_deep_ok.py",
     ],
 )
 def test_clean_fixture_passes(fixture):
@@ -841,6 +851,141 @@ def test_cacheinvariant_noop_hook_fails(tree_copy):
     rc, out = check_tree(tree_copy)
     assert rc != 0
     assert "[cacheinvariant]" in out and "no-op" in out
+
+
+# ------------------------------------------- call-graph transitive rules
+def test_asyncpurity_transitive_attributes_the_root():
+    # the violation anchors at the coroutine's call edge and names the
+    # chain — the terminal sleep is one frame down
+    rc, out = run_analyzer(
+        str(FIXTURES / "asyncpurity_transitive_bad.py"), "--rule", "asyncpurity"
+    )
+    assert rc != 0
+    assert "transitively reaches blocking call time.sleep()" in out
+    assert "via _drain()" in out
+
+
+def test_readback_transitive_attributes_the_call_edge():
+    rc, out = run_analyzer(
+        str(FIXTURES / "readback_transitive_bad.py"), "--rule", "readback"
+    )
+    assert rc != 0
+    assert "transitively forces a device sync" in out
+    assert "snapshot() calls _total()" in out
+
+
+def test_looppurity_fixture_bad():
+    root = FIXTURES / "looppurity_bad"
+    rc, out = run_analyzer(
+        str(root), "--root", str(root), "--rule", "loop-purity"
+    )
+    assert rc != 0
+    # all three finding kinds fire: parser entry, blocking call, lock
+    assert "reaches the parser" in out
+    assert "blocking call time.sleep()" in out
+    assert "acquired on the event-loop thread" in out
+
+
+def test_looppurity_fixture_ok():
+    # the clean twin passes EVERY rule: the loop-safe lock carries a
+    # site pragma, the parse hides behind a pragma'd hand-off edge
+    root = FIXTURES / "looppurity_ok"
+    rc, out = run_analyzer(str(root), "--root", str(root))
+    assert rc == 0, out
+
+
+def test_looppurity_edge_pragma_is_load_bearing(tmp_path):
+    # strip the edge escape from the clean twin: the walk descends into
+    # _dispatch and the parser entry must surface
+    root = tmp_path / "looppurity_stripped"
+    shutil.copytree(FIXTURES / "looppurity_ok", root)
+    f = root / "server" / "eventloop.py"
+    f.write_text(f.read_text().replace("  # pilosa: allow(loop-purity)\n", "\n", 1))
+    rc, out = run_analyzer(
+        str(root), "--root", str(root), "--rule", "loop-purity"
+    )
+    assert rc != 0, "stripping the edge pragma must surface the parser entry"
+    assert "reaches the parser" in out
+
+
+def test_live_tree_mark_loop_thread_wired():
+    # the loop-purity rule's runtime counterpart only works if the loop
+    # thread actually marks itself
+    src = (REPO / "pilosa_tpu" / "server" / "eventloop.py").read_text()
+    assert "sanitize.mark_loop_thread()" in src
+
+
+# --------------------------------------------------- cache + prune CLI
+def test_prune_pragmas_reports_stale(tmp_path):
+    p = tmp_path / "stale.py"
+    p.write_text("import time\n\nX = 1  # pilosa: allow(wall-clock)\n")
+    rc, out = run_analyzer(str(p), "--prune-pragmas")
+    assert rc != 0
+    assert "stale pragma allow(wall-clock)" in out
+
+
+def test_prune_pragmas_live_tree_all_live():
+    rc, out = run_analyzer(str(REPO / "pilosa_tpu"), "--prune-pragmas")
+    assert rc == 0, out
+    assert "pragmas: all live" in out
+
+
+def test_prune_pragmas_rejects_rule_scoping():
+    rc, _out = run_analyzer(
+        str(FIXTURES / "readback_ok.py"), "--prune-pragmas", "--rule", "readback"
+    )
+    assert rc == 2, "staleness is only provable against the full rule set"
+
+
+def test_ast_cache_written_and_invalidated(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("def f():\n    return 1\n")
+    rc, _ = run_analyzer(str(p), "--root", str(tmp_path))
+    assert rc == 0
+    assert (tmp_path / ".analysis-ast-cache.pkl").exists()
+    # a changed file must re-parse (mtime/size key), not serve the
+    # stale tree — the rewritten file seeds an asyncpurity violation
+    p.write_text("import time\n\n\nasync def f():\n    time.sleep(1)\n")
+    rc, out = run_analyzer(
+        str(p), "--root", str(tmp_path), "--rule", "asyncpurity"
+    )
+    assert rc != 0
+    assert "[asyncpurity]" in out
+
+
+def test_ast_cache_hit_reported_verbose(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("def f():\n    return 1\n")
+    run_analyzer(str(p), "--root", str(tmp_path))
+    rc, out = run_analyzer(str(p), "--root", str(tmp_path), "--verbose")
+    assert rc == 0
+    assert "1/1 ASTs from cache" in out
+    assert "-- rule " in out, "per-rule timings must print under --verbose"
+
+
+def test_emit_lock_graph_shape():
+    rc, out = run_analyzer(
+        str(FIXTURES / "lockorder_deep_bad.py"), "--emit-lock-graph"
+    )
+    assert rc == 0
+    graph = json.loads(out)
+    edges = {(a, b) for a, b, _src in graph["edges"]}
+    assert ("Coordinator._plan_lock", "Coordinator._stats_lock") in edges
+    assert ("Coordinator._stats_lock", "Coordinator._plan_lock") in edges
+    assert "Coordinator._plan_lock" in graph["locks"]
+
+
+def test_lock_graph_sees_through_constructors():
+    # the first `make sanitize` run observed
+    # Holder._create_lock -> TranslateStore._lock with NO static
+    # explanation: the edge runs through Index()'s constructor
+    # (`Index.__init__` opens `self.column_keys`, a ctor-typed attr).
+    # Constructor + attr-type resolution closed that blind spot — this
+    # pins it closed on the live tree.
+    rc, out = run_analyzer(str(REPO / "pilosa_tpu"), "--emit-lock-graph")
+    assert rc == 0
+    edges = {(a, b) for a, b, _src in json.loads(out)["edges"]}
+    assert ("Holder._create_lock", "TranslateStore._lock") in edges
 
 
 def test_metric_drift_stale_doc_row_fails(tree_copy):

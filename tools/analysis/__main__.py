@@ -3,15 +3,38 @@
 Exit status: 0 = clean, 1 = violations, 2 = usage error.  The tier-1
 gate (tests/test_analysis.py) runs this over the live tree and over
 seeded-violation fixtures and asserts on the exit codes.
+
+Extras:
+
+- ``--verbose`` prints per-rule wall timings and the parse/cache split
+  (the stated budget for the warm live-tree run is in
+  docs/static-analysis.md);
+- ``--prune-pragmas`` lists ``# pilosa: allow(...)`` comments that
+  neither suppressed a finding nor escaped a call-graph edge in this
+  run (exit 1 when any are stale — drift is a finding);
+- ``--no-cache`` skips the mtime-keyed parsed-AST cache
+  (``.analysis-ast-cache.pkl`` under the project root);
+- ``--emit-lock-graph`` prints the static holds-while-acquiring lock
+  graph as JSON for the runtime sanitizer
+  (``PILOSA_TPU_SANITIZE_STATIC``, docs/concurrency.md).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import sys
+import time
 from pathlib import Path
 
-from tools.analysis.engine import Project, get_rules, run
+from tools.analysis.engine import (
+    Project,
+    get_rules,
+    load_ast_cache,
+    run,
+    save_ast_cache,
+    stale_pragmas,
+)
 from tools.analysis.fixes import apply_fixes
 
 
@@ -47,12 +70,42 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    ap.add_argument(
+        "--verbose",
+        action="store_true",
+        help="print per-rule timings and cache statistics",
+    )
+    ap.add_argument(
+        "--prune-pragmas",
+        action="store_true",
+        help="report `# pilosa: allow` pragmas that no longer suppress "
+        "anything (requires running every rule; exit 1 when stale)",
+    )
+    ap.add_argument(
+        "--no-cache",
+        action="store_true",
+        help="ignore and do not write the parsed-AST cache",
+    )
+    ap.add_argument(
+        "--emit-lock-graph",
+        action="store_true",
+        help="print the static lock graph as JSON (for the runtime "
+        "sanitizer's PILOSA_TPU_SANITIZE_STATIC) and exit",
+    )
     args = ap.parse_args(argv)
 
     if args.list_rules:
         for name, r in sorted(get_rules().items()):
             print(f"{name:16s} {r.doc}")
         return 0
+
+    if args.prune_pragmas and args.rules:
+        print(
+            "error: --prune-pragmas needs every rule active (a pragma "
+            "is only provably stale against the full rule set)",
+            file=sys.stderr,
+        )
+        return 2
 
     paths = args.paths or ["pilosa_tpu"]
     if args.root:
@@ -67,11 +120,17 @@ def main(argv: list[str] | None = None) -> int:
             if (cand / "tools").is_dir() or (cand / ".git").exists():
                 root = cand
                 break
+
+    t0 = time.perf_counter()
+    ast_cache = {} if args.no_cache else load_ast_cache(root)
     try:
-        project = Project.discover(root, [Path(p) for p in paths])
+        project = Project.discover(
+            root, [Path(p) for p in paths], ast_cache=ast_cache
+        )
     except OSError as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
+    t_parse = time.perf_counter() - t0
     if not project.files:
         # a gate that silently checks zero files is a green light for
         # anything — a typo'd path or wrong cwd must fail loudly
@@ -82,6 +141,14 @@ def main(argv: list[str] | None = None) -> int:
         )
         return 2
 
+    if args.emit_lock_graph:
+        from tools.analysis.rules.locks import build_lock_graph
+
+        print(json.dumps(build_lock_graph(project), indent=2, sort_keys=True))
+        if not args.no_cache:
+            save_ast_cache(root, project)
+        return 0
+
     if args.fix:
         changed = 0
         for f in project.files:
@@ -91,24 +158,69 @@ def main(argv: list[str] | None = None) -> int:
                 changed += 1
         if changed:
             print(f"--fix rewrote {changed} file(s)")
-            project = Project.discover(root, [Path(p) for p in paths])
+            project = Project.discover(
+                root, [Path(p) for p in paths], ast_cache=ast_cache
+            )
 
+    timings: dict[str, float] = {}
     try:
-        violations = run(project, only=args.rules)
+        violations = run(project, only=args.rules, timings=timings)
     except KeyError as e:
         print(f"error: {e.args[0]}", file=sys.stderr)
         return 2
+    if not args.no_cache:
+        save_ast_cache(root, project)
     for v in violations:
         print(v.format())
+
+    if args.verbose:
+        cached = sum(
+            1
+            for f in project.files
+            if f.cache_key is not None
+            and ast_cache.get(str(f.abspath), (None, None))[:2] == f.cache_key
+        )
+        print(
+            f"-- parse: {t_parse * 1000:.0f} ms "
+            f"({cached}/{len(project.files)} ASTs from cache)",
+            file=sys.stderr,
+        )
+        for name in sorted(timings, key=lambda n: -timings[n]):
+            print(f"-- rule {name:16s} {timings[name] * 1000:6.0f} ms", file=sys.stderr)
+        print(
+            f"-- rules total: {sum(timings.values()) * 1000:.0f} ms",
+            file=sys.stderr,
+        )
+
     n_files = len(project.files)
+    rc = 0
     if violations:
         print(
             f"\n{len(violations)} violation(s) across {n_files} file(s)",
             file=sys.stderr,
         )
-        return 1
-    print(f"OK: {n_files} file(s) clean")
-    return 0
+        rc = 1
+
+    if args.prune_pragmas:
+        stale = stale_pragmas(project)
+        for rel, line, rule_name in stale:
+            print(
+                f"{rel}:{line}: stale pragma allow({rule_name}) — "
+                "nothing on this line fires that rule anymore"
+            )
+        if stale:
+            print(
+                f"\n{len(stale)} stale pragma(s) — remove them or fix the "
+                "line they were protecting",
+                file=sys.stderr,
+            )
+            rc = rc or 1
+        elif rc == 0:
+            print("pragmas: all live")
+
+    if rc == 0 and not violations:
+        print(f"OK: {n_files} file(s) clean")
+    return rc
 
 
 if __name__ == "__main__":

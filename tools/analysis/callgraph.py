@@ -1,0 +1,463 @@
+"""Whole-program call graph shared by the reachability rules.
+
+The transitive rules (``asyncpurity``, ``readback``, ``lock-order``,
+``loop-purity``) all ask the same question — *what can this function
+reach?* — so the resolution logic lives here once, with one documented
+precision contract (docs/static-analysis.md):
+
+Resolved call shapes, in order of preference:
+
+- ``inner()``        → a ``def`` nested in the calling function;
+- ``helper()``       → a module-level function in the same file;
+- ``name()``         → a ``from mod import name`` binding whose target
+                       module is in the analyzed set;
+- ``helper()``       → the unique module-level ``helper`` repo-wide;
+- ``self.m()`` / ``cls.m()``
+                     → method ``m`` of the caller's own class (same
+                       file), falling back to the unique class repo-wide
+                       that defines ``m`` (mixin/base splits like
+                       ``_ServerCore`` resolve through this);
+- ``mod.f()``        → module-level ``f`` when ``mod`` names an imported
+                       module in the analyzed set (``resilience.
+                       deadline_from_header`` → parallel/resilience.py);
+- ``Cls()``          → ``Cls.__init__`` when ``Cls`` is an analyzed
+                       module-level class (same file, from-import,
+                       ``mod.Cls``, or unique repo-wide) — constructors
+                       run real code (``Index()`` opens translate
+                       stores under the holder's create lock);
+- ``self.attr.m()``  → method ``m`` of the class assigned to
+                       ``self.attr`` in the owning class's own methods
+                       (``self.column_keys = TranslateStore(...)``
+                       types the attribute; conflicting assignments
+                       make it untyped again);
+- ``obj.m()``        → method ``m`` when exactly ONE analyzed class
+                       defines it — an ambiguous name (``close``,
+                       ``snapshot``) resolves to nothing rather than
+                       fabricating edges.
+
+Everything else — dynamic dispatch, callables in containers, getattr —
+is out of scope: the graph UNDER-approximates, which is the right
+direction for rules that must stay quiet on the live tree (the runtime
+sanitizer covers the dynamic remainder; docs/concurrency.md).
+
+Per-edge escape: a ``# pilosa: allow(<rule>)`` pragma on a CALL line
+cuts that edge out of rule ``<rule>``'s reachability walk — "this call
+is proven safe for this invariant; do not descend".  The engine records
+the pragma as *used* so ``--prune-pragmas`` never reports load-bearing
+edge escapes as stale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from tools.analysis.engine import Project, SourceFile, call_name
+
+# Method names that also exist on builtin types (str.join, io close,
+# dict.get, Thread.start, ...).  The unique-repo-wide-method fallback
+# must NOT fire for these — `"\r\n".join(lines)` is not `Cluster.join`
+# — except when the receiver chain is rooted at `self`/`cls`, where the
+# object is known to be repo state (`self.stats.count` really is
+# `StatsClient.count`).
+_BUILTINISH: set[str] = set()
+for _t in (str, bytes, bytearray, list, dict, set, frozenset, tuple,
+           int, float, complex, object):
+    _BUILTINISH.update(n for n in dir(_t) if not n.startswith("_"))
+_BUILTINISH.update({
+    "close", "open", "read", "write", "flush", "readline", "readlines",
+    "seek", "tell", "fileno",                      # io
+    "start", "run", "cancel", "set", "is_set", "wait", "notify",
+    "notify_all", "acquire", "release", "locked",  # threading
+    "send", "recv", "connect", "bind", "listen", "accept", "sendall",
+    "put", "get_nowait", "put_nowait", "task_done",  # socket/queue
+    "submit", "result", "done", "shutdown",        # futures
+    "match", "search", "sub", "findall", "group",  # re
+})
+del _t
+
+_MISS = object()  # cache sentinel distinct from a legitimate None
+
+
+class FuncInfo:
+    """One function or method definition plus its outgoing call sites."""
+
+    __slots__ = (
+        "key", "rel", "qualname", "name", "cls", "parent_qual",
+        "lineno", "is_async", "node", "calls",
+    )
+
+    def __init__(self, rel: str, qualname: str, name: str,
+                 cls: str | None, parent_qual: str | None,
+                 lineno: int, is_async: bool, node: ast.AST):
+        self.key = (rel, qualname)
+        self.rel = rel
+        self.qualname = qualname
+        self.name = name
+        self.cls = cls
+        self.parent_qual = parent_qual  # enclosing function's qualname
+        self.lineno = lineno
+        self.is_async = is_async
+        self.node = node
+        # (dotted_name, line) for every call in the OWN body — nested
+        # function definitions are excluded (their bodies are their own
+        # FuncInfo; an edge to them exists only when they are called)
+        self.calls: list[tuple[str, int]] = []
+
+
+def _own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of a function's own body, not descending into nested
+    function/class definitions (mirrors the asyncpurity walk)."""
+    stack: list[ast.AST] = list(getattr(fn, "body", []))
+    # decorators/defaults belong to the enclosing scope's execution
+    for field in ("args",):
+        sub = getattr(fn, field, None)
+        if sub is not None:
+            stack.extend(d for d in getattr(sub, "defaults", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def module_name(rel: str) -> str:
+    """Dotted module path of a project-relative file path."""
+    parts = rel[:-3].split("/") if rel.endswith(".py") else rel.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+class CallGraph:
+    def __init__(self, project: Project):
+        self.project = project
+        self.functions: dict[tuple[str, str], FuncInfo] = {}
+        # module dotted name -> file rel
+        self._modules: dict[str, str] = {}
+        # per-file import maps: local alias -> dotted module, and
+        # local name -> (dotted module, symbol) for from-imports
+        self._mod_imports: dict[str, dict[str, str]] = {}
+        self._sym_imports: dict[str, dict[str, tuple[str, str]]] = {}
+        # resolution indexes
+        self._module_funcs: dict[tuple[str, str], FuncInfo] = {}  # (rel, name)
+        self._funcs_by_name: dict[str, list[FuncInfo]] = {}
+        self._methods_by_cls: dict[tuple[str, str], list[FuncInfo]] = {}
+        self._methods_by_name: dict[str, list[FuncInfo]] = {}
+        # module-level classes: (rel, name) presence + name -> [rel]
+        self._classes: set[tuple[str, str]] = set()
+        self._classes_by_name: dict[str, list[str]] = {}
+        # (rel, cls, attr) -> dotted ctor name from `self.attr = X(...)`
+        # assignments in the class's own methods; None == conflicting
+        self._attr_ctor: dict[tuple[str, str, str], str | None] = {}
+        self._attr_cls_cache: dict[tuple[str, str, str],
+                                   tuple[str, str] | None] = {}
+        # memoized rule-independent resolution: key -> [(target, line)]
+        self._resolved: dict[tuple[str, str], list[tuple[FuncInfo, int]]] = {}
+        for f in project.files:
+            self._index_file(f)
+
+    # ------------------------------------------------------------ indexing
+    def _index_file(self, f: SourceFile) -> None:
+        if f.tree is None:
+            return
+        self._modules[module_name(f.rel)] = f.rel
+        mod_imp: dict[str, str] = {}
+        sym_imp: dict[str, tuple[str, str]] = {}
+        pkg = module_name(f.rel).rsplit(".", 1)[0] if "." in module_name(f.rel) else ""
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod_imp[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0]
+                    )
+                    if a.asname:
+                        mod_imp[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    # relative import: resolve against this file's package
+                    parts = module_name(f.rel).split(".")
+                    # level 1 = current package (drop the module segment)
+                    anchor = parts[: len(parts) - node.level]
+                    base = ".".join(anchor + ([node.module] if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    local = a.asname or a.name
+                    # `from pkg import mod` importing a submodule acts
+                    # as a module alias; otherwise it binds a symbol
+                    sub = f"{base}.{a.name}" if base else a.name
+                    sym_imp[local] = (base, a.name)
+                    mod_imp.setdefault(local, sub)
+        self._mod_imports[f.rel] = mod_imp
+        self._sym_imports[f.rel] = sym_imp
+
+        def visit(node: ast.AST, cls: str | None, parent: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    if cls is None and parent is None:
+                        self._classes.add((f.rel, child.name))
+                        self._classes_by_name.setdefault(
+                            child.name, []
+                        ).append(f.rel)
+                    visit(child, child.name, parent)
+                elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    name = child.name
+                    if parent is not None:
+                        qual = f"{parent}.<locals>.{name}"
+                    elif cls is not None:
+                        qual = f"{cls}.{name}"
+                    else:
+                        qual = name
+                    info = FuncInfo(
+                        f.rel, qual, name, cls if parent is None else None,
+                        parent, child.lineno,
+                        isinstance(child, ast.AsyncFunctionDef), child,
+                    )
+                    for n in _own_nodes(child):
+                        if isinstance(n, ast.Call):
+                            dn = call_name(n.func)
+                            if dn:
+                                info.calls.append((dn, n.lineno))
+                        if info.cls is not None:
+                            self._note_attr_types(f.rel, info.cls, n)
+                    self.functions[info.key] = info
+                    if parent is None and cls is None:
+                        self._module_funcs[(f.rel, name)] = info
+                        self._funcs_by_name.setdefault(name, []).append(info)
+                    elif parent is None:
+                        self._methods_by_cls.setdefault(
+                            (cls, name), []
+                        ).append(info)
+                        self._methods_by_name.setdefault(name, []).append(info)
+                    # nested defs index under their qualname only —
+                    # reachable via the enclosing function's bare call
+                    visit(child, None, qual)
+
+        visit(f.tree, None, None)
+
+    def _note_attr_types(self, rel: str, cls: str, node: ast.AST) -> None:
+        """Record `self.attr = Ctor(...)` so `self.attr.m()` can resolve
+        by the attribute's constructed class.  Conflicting constructors
+        for one attribute make it untyped again (None sentinel)."""
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        else:
+            return
+        if not isinstance(value, ast.Call):
+            return
+        dn = call_name(value.func)
+        if not dn:
+            return
+        for t in targets:
+            if (
+                isinstance(t, ast.Attribute)
+                and isinstance(t.value, ast.Name)
+                and t.value.id == "self"
+            ):
+                key = (rel, cls, t.attr)
+                prev = self._attr_ctor.get(key, dn)
+                self._attr_ctor[key] = dn if prev == dn else None
+
+    # ----------------------------------------------------------- resolution
+    def _class_init(self, rel: str, clsname: str) -> list[FuncInfo]:
+        """``Cls(...)`` edges to ``Cls.__init__`` when the analyzed class
+        defines one (no ``__init__`` in the analyzed set → no edge)."""
+        for m in self._methods_by_cls.get((clsname, "__init__"), []):
+            if m.rel == rel:
+                return [m]
+        return []
+
+    def _resolve_class(self, rel: str, dotted: str) -> tuple[str, str] | None:
+        """Resolve a constructor name as seen from ``rel`` to an
+        analyzed module-level class: same file, from-import, ``mod.Cls``
+        via an imported module, or unique repo-wide."""
+        parts = dotted.split(".")
+        if len(parts) == 1:
+            name = parts[0]
+            if (rel, name) in self._classes:
+                return (rel, name)
+            sym = self._sym_imports.get(rel, {}).get(name)
+            if sym is not None:
+                mod_rel = self._modules.get(sym[0])
+                if mod_rel is not None and (mod_rel, sym[1]) in self._classes:
+                    return (mod_rel, sym[1])
+            rels = self._classes_by_name.get(name, [])
+            return (rels[0], name) if len(rels) == 1 else None
+        if len(parts) == 2:
+            mod = self._mod_imports.get(rel, {}).get(parts[0])
+            if mod is not None:
+                mod_rel = self._modules.get(mod)
+                if mod_rel is not None and (mod_rel, parts[1]) in self._classes:
+                    return (mod_rel, parts[1])
+        return None
+
+    def _attr_class(self, rel: str, cls: str,
+                    attr: str) -> tuple[str, str] | None:
+        """The analyzed class `self.<attr>` holds on instances of `cls`,
+        per `self.attr = Ctor(...)` assignments in cls's own methods."""
+        key = (rel, cls, attr)
+        hit = self._attr_cls_cache.get(key, _MISS)
+        if hit is not _MISS:
+            return hit
+        dn = self._attr_ctor.get(key)
+        out = self._resolve_class(rel, dn) if dn else None
+        self._attr_cls_cache[key] = out
+        return out
+
+    def resolve(self, caller: FuncInfo, dotted: str) -> list[FuncInfo]:
+        """Call targets of ``dotted`` as seen from ``caller`` (possibly
+        empty — unresolved/dynamic calls contribute no edges)."""
+        parts = dotted.split(".")
+        rel = caller.rel
+        if len(parts) == 1:
+            name = parts[0]
+            # nested def in this function (or an enclosing one)
+            qual = caller.qualname
+            while qual:
+                hit = self.functions.get((rel, f"{qual}.<locals>.{name}"))
+                if hit is not None:
+                    return [hit]
+                qual = qual.rsplit(".<locals>.", 1)[0] if ".<locals>." in qual else ""
+            hit = self._module_funcs.get((rel, name))
+            if hit is not None:
+                return [hit]
+            if (rel, name) in self._classes:
+                return self._class_init(rel, name)
+            sym = self._sym_imports.get(rel, {}).get(name)
+            if sym is not None:
+                mod_rel = self._modules.get(sym[0])
+                if mod_rel is not None:
+                    hit = self._module_funcs.get((mod_rel, sym[1]))
+                    if hit is not None:
+                        return [hit]
+                    if (mod_rel, sym[1]) in self._classes:
+                        return self._class_init(mod_rel, sym[1])
+            owners = self._funcs_by_name.get(name, [])
+            if len(owners) == 1:
+                return [owners[0]]
+            rels = self._classes_by_name.get(name, [])
+            if len(rels) == 1:
+                return self._class_init(rels[0], name)
+            return []
+        if len(parts) == 2:
+            recv, meth = parts
+            if recv in ("self", "cls") and caller.cls is not None:
+                hits = [
+                    m for m in self._methods_by_cls.get((caller.cls, meth), [])
+                    if m.rel == rel
+                ] or self._methods_by_cls.get((caller.cls, meth), [])
+                if hits:
+                    return hits[:1]
+                # mixin/base split: unique definer repo-wide
+                owners = self._methods_by_name.get(meth, [])
+                return [owners[0]] if len(owners) == 1 else []
+            mod = self._mod_imports.get(rel, {}).get(recv)
+            if mod is not None:
+                mod_rel = self._modules.get(mod)
+                if mod_rel is not None:
+                    hit = self._module_funcs.get((mod_rel, meth))
+                    if hit is not None:
+                        return [hit]
+                    if (mod_rel, meth) in self._classes:
+                        return self._class_init(mod_rel, meth)
+            if meth in _BUILTINISH:
+                return []
+            owners = self._methods_by_name.get(meth, [])
+            return [owners[0]] if len(owners) == 1 else []
+        # a.b.c(...): try `a.b` as an imported module path, else the
+        # unique method named by the tail
+        tail = parts[-1]
+        if len(parts) == 3 and parts[0] == "self" and caller.cls is not None:
+            # `self.attr.m()` with a constructor-typed attr: resolve m
+            # against THAT class only — a typed attr never falls back to
+            # the unique-method guess (which could name a different
+            # class entirely)
+            tgt = self._attr_class(rel, caller.cls, parts[1])
+            if tgt is not None:
+                trel, tcls = tgt
+                hits = [
+                    m for m in self._methods_by_cls.get((tcls, tail), [])
+                    if m.rel == trel
+                ]
+                return hits[:1]
+        mod_alias = self._mod_imports.get(rel, {}).get(parts[0])
+        if mod_alias is not None:
+            dotted_mod = ".".join([mod_alias] + parts[1:-1])
+            mod_rel = self._modules.get(dotted_mod)
+            if mod_rel is not None:
+                hit = self._module_funcs.get((mod_rel, tail))
+                if hit is not None:
+                    return [hit]
+        if tail in _BUILTINISH and parts[0] not in ("self", "cls"):
+            return []
+        owners = self._methods_by_name.get(tail, [])
+        return [owners[0]] if len(owners) == 1 else []
+
+    def callees(
+        self, caller: FuncInfo, rule: str | None = None
+    ) -> Iterator[tuple[FuncInfo, int]]:
+        """(target, call line) pairs for every resolved call in
+        ``caller``.  With ``rule`` given, edges whose call line carries
+        ``# pilosa: allow(<rule>)`` are skipped (per-edge escape) and
+        the pragma is recorded as used.  Resolution is rule-independent
+        and memoized — only the pragma filter differs per rule."""
+        resolved = self._resolved.get(caller.key)
+        if resolved is None:
+            resolved = [
+                (target, line)
+                for dotted, line in caller.calls
+                for target in self.resolve(caller, dotted)
+            ]
+            self._resolved[caller.key] = resolved
+        src = self.project._by_rel.get(caller.rel)
+        for target, line in resolved:
+            if rule is not None and src is not None and src.allowed(rule, line):
+                self.project.note_pragma_use(caller.rel, line, rule)
+                continue
+            yield target, line
+
+    # --------------------------------------------------------- reachability
+    def reachable(
+        self,
+        roots: list[FuncInfo],
+        rule: str,
+        *,
+        through: "callable | None" = None,
+    ) -> dict[tuple[str, str], list[tuple[FuncInfo, int]]]:
+        """BFS closure from ``roots``: reached function key → the first
+        discovered path, as [(callee, call line), ...] — path[0] is the
+        edge leaving the root (anchor the violation there), path[-1] is
+        the reached function.  ``through(func)`` (when given) gates
+        whether the walk descends PAST a reached function — the function
+        itself is still reported as reached."""
+        out: dict[tuple[str, str], list[tuple[FuncInfo, int]]] = {}
+        frontier: list[FuncInfo] = []
+        for r in roots:
+            out.setdefault(r.key, [])
+            frontier.append(r)
+        while frontier:
+            cur = frontier.pop(0)
+            path = out[cur.key]
+            if through is not None and path and not through(cur):
+                continue
+            for target, line in self.callees(cur, rule):
+                if target.key in out:
+                    continue
+                out[target.key] = path + [(target, line)]
+                frontier.append(target)
+        return out
+
+
+def get_callgraph(project: Project) -> CallGraph:
+    """The project's call graph, built once per Project instance."""
+    cg = getattr(project, "_callgraph", None)
+    if cg is None:
+        cg = CallGraph(project)
+        project._callgraph = cg
+    return cg

@@ -23,13 +23,58 @@ starts.)  ``# noqa: BLE001`` is honored as an alias for
 from __future__ import annotations
 
 import ast
+import os
+import pickle
 import re
+import time
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Callable, Iterable
 
 _PRAGMA_RE = re.compile(r"#\s*pilosa:\s*allow\(([^)]*)\)")
 _NOQA_BLE_RE = re.compile(r"#\s*noqa:[^\n]*\bBLE001\b")
+
+# Parsed-AST cache: {abspath: (mtime_ns, size, tree)} pickled under the
+# project root.  Keyed on (mtime_ns, size) so an edited file re-parses;
+# version-tagged so a format change invalidates wholesale.  The cache
+# is an optimization only — any load failure silently falls back to
+# parsing (a corrupt cache must never wedge the gate).
+_AST_CACHE_VERSION = 1
+_AST_CACHE_NAME = ".analysis-ast-cache.pkl"
+
+
+def load_ast_cache(root: Path) -> dict:
+    path = Path(root) / _AST_CACHE_NAME
+    try:
+        with open(path, "rb") as fh:
+            data = pickle.load(fh)
+        if data.get("version") == _AST_CACHE_VERSION:
+            return data.get("files", {})
+    except Exception:  # pilosa: allow(broad-except) — cache is best-effort
+        pass
+    return {}
+
+
+def save_ast_cache(root: Path, project: "Project") -> None:
+    path = Path(root) / _AST_CACHE_NAME
+    files = {}
+    for f in project.files:
+        if f.tree is not None and f.cache_key is not None:
+            files[str(f.abspath)] = (*f.cache_key, f.tree)
+    tmp = path.with_suffix(".pkl.tmp")
+    try:
+        with open(tmp, "wb") as fh:
+            pickle.dump(
+                {"version": _AST_CACHE_VERSION, "files": files},
+                fh,
+                protocol=pickle.HIGHEST_PROTOCOL,
+            )
+        os.replace(tmp, path)
+    except Exception:  # pilosa: allow(broad-except) — cache is best-effort
+        try:
+            tmp.unlink(missing_ok=True)
+        except OSError:
+            pass
 
 
 @dataclass(frozen=True)
@@ -46,23 +91,37 @@ class Violation:
 class SourceFile:
     """One parsed source file plus its suppression pragmas."""
 
-    def __init__(self, root: Path, path: Path):
+    def __init__(self, root: Path, path: Path, cache: dict | None = None):
         self.abspath = path
         self.rel = path.relative_to(root).as_posix()
         self.text = path.read_text(encoding="utf-8")
         self.lines = self.text.splitlines()
         self.tree: ast.Module | None = None
         self.parse_error: SyntaxError | None = None
+        self.cache_key: tuple[int, int] | None = None
         try:
-            self.tree = ast.parse(self.text, filename=str(path))
-        except SyntaxError as e:
-            self.parse_error = e
+            st = path.stat()
+            self.cache_key = (st.st_mtime_ns, st.st_size)
+        except OSError:
+            pass
+        hit = cache.get(str(path)) if cache else None
+        if hit is not None and self.cache_key is not None and hit[:2] == self.cache_key:
+            self.tree = hit[2]
+        else:
+            try:
+                self.tree = ast.parse(self.text, filename=str(path))
+            except SyntaxError as e:
+                self.parse_error = e
         self._allows: dict[int, set[str]] = {}
+        # `# pilosa: allow(...)` pragmas only (the prune pass ignores
+        # the noqa alias — BLE001 may belong to ruff, not to us)
+        self.pragma_decls: dict[int, set[str]] = {}
         for i, line in enumerate(self.lines, start=1):
             m = _PRAGMA_RE.search(line)
             if m:
                 names = {s.strip() for s in m.group(1).split(",") if s.strip()}
                 self._allows.setdefault(i, set()).update(names)
+                self.pragma_decls.setdefault(i, set()).update(names)
             if _NOQA_BLE_RE.search(line):
                 self._allows.setdefault(i, set()).add("broad-except")
 
@@ -91,7 +150,12 @@ class Project:
     by suffix so the same rule runs against the live tree and against a
     mutated copy in tests)."""
 
-    def __init__(self, root: Path, paths: Iterable[Path]):
+    def __init__(
+        self,
+        root: Path,
+        paths: Iterable[Path],
+        ast_cache: dict | None = None,
+    ):
         self.root = Path(root).resolve()
         self.files: list[SourceFile] = []
         seen: set[Path] = set()
@@ -99,11 +163,23 @@ class Project:
             if p in seen:
                 continue
             seen.add(p)
-            self.files.append(SourceFile(self.root, p))
+            self.files.append(SourceFile(self.root, p, ast_cache))
         self._by_rel = {f.rel: f for f in self.files}
+        # (rel, line, rule) pragmas that actually suppressed a finding
+        # or escaped a call-graph edge this run — the prune pass reports
+        # declared-but-unused pragmas against this set
+        self.used_pragmas: set[tuple[str, int, str]] = set()
+
+    def note_pragma_use(self, rel: str, line: int, rule: str) -> None:
+        self.used_pragmas.add((rel, line, rule))
 
     @classmethod
-    def discover(cls, root: Path, targets: Iterable[Path] | None = None) -> "Project":
+    def discover(
+        cls,
+        root: Path,
+        targets: Iterable[Path] | None = None,
+        ast_cache: dict | None = None,
+    ) -> "Project":
         root = Path(root).resolve()
         paths: list[Path] = []
         for t in targets or [root]:
@@ -118,7 +194,7 @@ class Project:
                 )
             elif t.suffix == ".py":
                 paths.append(t)
-        return cls(root, paths)
+        return cls(root, paths, ast_cache)
 
     def find(self, suffix: str) -> SourceFile | None:
         """The unique file whose project-relative path ends with
@@ -174,13 +250,16 @@ def filter_suppressed(project: Project, violations: list[Violation]) -> list[Vio
     for v in violations:
         f = project._by_rel.get(v.path)
         if f is not None and f.allowed(v.rule, v.line):
+            project.note_pragma_use(v.path, v.line, v.rule)
             continue
         out.append(v)
     return out
 
 
 def run(
-    project: Project, only: Iterable[str] | None = None
+    project: Project,
+    only: Iterable[str] | None = None,
+    timings: dict[str, float] | None = None,
 ) -> list[Violation]:
     rules = get_rules()
     names = list(only) if only else sorted(rules)
@@ -199,10 +278,32 @@ def run(
                 )
             )
     for n in names:
+        t0 = time.perf_counter()
         violations.extend(rules[n].check(project))
+        if timings is not None:
+            timings[n] = time.perf_counter() - t0
     violations = filter_suppressed(project, violations)
     violations.sort(key=lambda v: (v.path, v.line, v.rule))
     return violations
+
+
+def stale_pragmas(
+    project: Project, violations_ran: bool = True
+) -> list[tuple[str, int, str]]:
+    """(rel, line, rule) for every declared ``# pilosa: allow`` pragma
+    that neither suppressed a finding nor escaped a call-graph edge in
+    the run that just completed.  ``*`` pragmas are never reported (a
+    blanket allow is a reviewed decision, not drift), and unknown rule
+    names ARE reported — a typo'd pragma suppresses nothing."""
+    out: list[tuple[str, int, str]] = []
+    for f in project.files:
+        for line, names in sorted(f.pragma_decls.items()):
+            for rule_name in sorted(names):
+                if rule_name == "*":
+                    continue
+                if (f.rel, line, rule_name) not in project.used_pragmas:
+                    out.append((f.rel, line, rule_name))
+    return out
 
 
 # ----------------------------------------------------------- AST helpers

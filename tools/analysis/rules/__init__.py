@@ -8,6 +8,7 @@ from tools.analysis.rules import (  # noqa: F401
     configdrift,
     durability,
     locks,
+    looppurity,
     observability,
     parity,
     readback,

@@ -19,8 +19,19 @@ target that executes elsewhere), these calls are banned:
   ``loop.run_in_executor`` is the one sanctioned hand-off point, and it
   is exempt by construction (the callable is passed, not called)
 
+Enforcement is WHOLE-PROGRAM (call graph, docs/static-analysis.md): a
+banned call is flagged in the coroutine's own body AND when it is
+transitively reachable through sync helpers the coroutine calls — a
+``time.sleep`` three helpers deep stalls the loop exactly as hard as
+one written inline.  Async callees are not descended into (each
+coroutine is checked as its own root), and calls handed to the pool
+(``run_in_executor(pool, fn, ...)``) contribute no edge by construction
+— the callable is passed, not called.
+
 Suppression: ``# pilosa: allow(asyncpurity)`` on the flagged line, for
-the rare case where a call is provably non-blocking.
+the rare case where a call is provably non-blocking; the same pragma on
+an intermediate CALL line cuts that edge out of the reachability walk
+(per-edge escape — "this helper is safe from this context").
 """
 
 from __future__ import annotations
@@ -54,8 +65,8 @@ _BANNED_BARE = {
 _SOCKET_METHODS = {"accept", "recv", "recv_into", "sendall"}
 
 
-def _own_calls(fn: ast.AsyncFunctionDef) -> Iterator[ast.Call]:
-    """Call nodes in the coroutine's own body, not descending into
+def _own_calls(fn: ast.AST) -> Iterator[ast.Call]:
+    """Call nodes in the function's own body, not descending into
     nested function definitions (nested async defs are visited as
     coroutines in their own right by the outer walk)."""
     stack: list[ast.AST] = list(fn.body)
@@ -68,41 +79,99 @@ def _own_calls(fn: ast.AsyncFunctionDef) -> Iterator[ast.Call]:
         stack.extend(ast.iter_child_nodes(node))
 
 
+def blocking_calls(fn: ast.AST) -> Iterator[tuple[str, str, int]]:
+    """(dotted name, why, line) for every banned blocking call in the
+    function's own body — shared by the direct check, the transitive
+    check, and the loop-purity rule."""
+    for c in _own_calls(fn):
+        name = call_name(c.func)
+        why = None
+        if name in _BANNED_DOTTED:
+            why = _BANNED_DOTTED[name]
+        elif name in _BANNED_BARE:
+            why = _BANNED_BARE[name]
+        else:
+            tail = name.rsplit(".", 1)[-1] if "." in name else ""
+            if tail in _SOCKET_METHODS:
+                why = (
+                    "blocking socket method; "
+                    "asyncio streams own the I/O"
+                )
+        if why is not None:
+            yield name, why, c.lineno
+
+
+def _chain(path) -> str:
+    """Human-readable call chain `a() -> b() -> c()` from a reachability
+    path [(callee, line), ...]."""
+    return " -> ".join(f"{t.qualname}()" for t, _ in path)
+
+
 @rule(
     "asyncpurity",
-    "no blocking I/O, sleeps, or thread spawns inside event-loop coroutines",
+    "no blocking I/O, sleeps, or thread spawns reachable from event-loop "
+    "coroutines",
 )
 def check_asyncpurity(project: Project) -> list[Violation]:
+    from tools.analysis.callgraph import get_callgraph
+
     out: list[Violation] = []
+    # direct pass: banned calls written inline in a coroutine body
     for f in project.files:
         if f.tree is None:
             continue
         for fn in ast.walk(f.tree):
             if not isinstance(fn, ast.AsyncFunctionDef):
                 continue
-            for c in _own_calls(fn):
-                name = call_name(c.func)
-                why = None
-                if name in _BANNED_DOTTED:
-                    why = _BANNED_DOTTED[name]
-                elif name in _BANNED_BARE:
-                    why = _BANNED_BARE[name]
-                else:
-                    tail = name.rsplit(".", 1)[-1] if "." in name else ""
-                    if tail in _SOCKET_METHODS:
-                        why = (
-                            "blocking socket method in a coroutine; "
-                            "asyncio streams own the I/O"
-                        )
-                if why is not None:
-                    out.append(
-                        Violation(
-                            "asyncpurity",
-                            f.rel,
-                            c.lineno,
-                            f"blocking call {name}() inside event-loop "
-                            f"coroutine {fn.name}() — {why} (sanctioned "
-                            "hand-off: loop.run_in_executor)",
-                        )
+            for name, why, line in blocking_calls(fn):
+                out.append(
+                    Violation(
+                        "asyncpurity",
+                        f.rel,
+                        line,
+                        f"blocking call {name}() inside event-loop "
+                        f"coroutine {fn.name}() — {why} (sanctioned "
+                        "hand-off: loop.run_in_executor)",
                     )
+                )
+
+    # transitive pass: banned calls inside sync helpers a coroutine
+    # reaches.  Each coroutine is its own root; async callees are not
+    # descended into (they get their own walk, and awaiting them does
+    # not execute blocking code synchronously in THIS frame's turn —
+    # the violation belongs to the coroutine that owns the fact).
+    cg = get_callgraph(project)
+    roots = [fn for fn in cg.functions.values() if fn.is_async]
+    seen: set[tuple[str, int, str, int]] = set()
+    for root in roots:
+        reached = cg.reachable(
+            [root], "asyncpurity", through=lambda fi: not fi.is_async
+        )
+        for key, path in reached.items():
+            if not path:  # the root itself — covered by the direct pass
+                continue
+            target = cg.functions[key]
+            if target.is_async:
+                continue
+            src = project._by_rel.get(target.rel)
+            for name, why, line in blocking_calls(target.node):
+                if src is not None and src.allowed("asyncpurity", line):
+                    project.note_pragma_use(target.rel, line, "asyncpurity")
+                    continue
+                anchor = path[0][1]  # the call line leaving the root
+                dedup = (root.rel, anchor, f"{target.key}", line)
+                if dedup in seen:
+                    continue
+                seen.add(dedup)
+                out.append(
+                    Violation(
+                        "asyncpurity",
+                        root.rel,
+                        anchor,
+                        f"coroutine {root.qualname}() transitively reaches "
+                        f"blocking call {name}() via {_chain(path)} "
+                        f"at {target.rel}:{line} — {why} (cut the chain "
+                        "with loop.run_in_executor, or pragma the edge)",
+                    )
+                )
     return out

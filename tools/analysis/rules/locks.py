@@ -12,12 +12,24 @@ Two checks over every analyzed module:
 2. **lock-order** — a directed graph of "holds A while acquiring B",
    built from (a) ``with``-statements nested inside other
    ``with``-statements over lock-like expressions, in the same
-   function, and (b) one level of interprocedural resolution: a call to
-   a method *of the analyzed set* from inside a with-lock block
-   contributes the locks that method acquires.  Any cycle in the graph
-   is a potential AB/BA deadlock between
+   function, (b) one level of name-based resolution (a call to the
+   unique acquiring method of that name), and (c) the WHOLE-PROGRAM
+   closure over the shared call graph: a call made while holding H
+   contributes H → every lock the callee *effectively* acquires, where
+   effective acquires are a fixpoint over the callee's own transitive
+   callees — a lock taken three frames below the held region still
+   orders after H.  Any cycle in the graph is a potential AB/BA
+   deadlock between
    ``core/holder.py``/``core/fragment.py``/``parallel/cluster.py``/
    ``executor/router.py`` threads and is reported with the full cycle.
+   ``# pilosa: allow(lock-order)`` on a call line cuts that edge from
+   the closure (e.g. a callback invoked only after the hold is
+   released).
+
+   ``build_lock_graph(project)`` exports the full edge set with
+   provenance — the runtime sanitizer (``pilosa_tpu/utils/sanitize.py``)
+   compares the OBSERVED holds-while-acquiring graph against it and
+   reports dynamic edges the static analysis never predicted.
 
 Lock identity is lexical: ``ClassName.attr`` for ``self.<attr>`` /
 ``obj.<attr>`` expressions whose attribute name looks lock-like
@@ -201,6 +213,19 @@ def _release_guarded(
     return False
 
 
+def _scan_cached(project: Project, node, cls: str | None, rel: str):
+    """Memoized ``_scan_function`` — raw-acquire and lock-order both
+    scan every function; the trees live as long as the project, so
+    id(node) is a stable key."""
+    memo = getattr(project, "_lock_scans", None)
+    if memo is None:
+        memo = project._lock_scans = {}
+    hit = memo.get(id(node))
+    if hit is None:
+        hit = memo[id(node)] = _scan_function(node, cls, rel)
+    return hit
+
+
 @rule(
     "raw-acquire",
     "lock.acquire() without `with` or try/finally release",
@@ -213,32 +238,31 @@ def check_raw_acquire(project: Project) -> list[Violation]:
         cls_of = _enclosing_class(f.tree)
         for node in ast.walk(f.tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                _info, vs = _scan_function(node, cls_of.get(id(node)), f.rel)
+                _info, vs = _scan_cached(project, node, cls_of.get(id(node)), f.rel)
                 out.extend(vs)
     return out
 
 
-@rule(
-    "lock-order",
-    "cycles in the holds-A-while-acquiring-B lock graph",
-)
-def check_lock_order(project: Project) -> list[Violation]:
-    infos: list[_FnInfo] = []
-    for f in project.files:
-        if f.tree is None:
-            continue
-        cls_of = _enclosing_class(f.tree)
-        for node in ast.walk(f.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                info, _vs = _scan_function(node, cls_of.get(id(node)), f.rel)
-                infos.append(info)
+def _collect_edges(project: Project) -> dict[tuple[str, str], tuple[str, int]]:
+    """The full holds-A-while-acquiring-B edge set with provenance
+    (rel, line) — shared by the cycle check and ``build_lock_graph``."""
+    cached = getattr(project, "_lock_edges", None)
+    if cached is not None:
+        return cached
+    from tools.analysis.callgraph import get_callgraph
 
-    # One-level interprocedural closure: a call to a resolvable method
-    # while holding H adds H -> every lock that method acquires
-    # directly.  Resolution: `self.m()` binds to m in the caller's own
-    # class; `obj.m()` / bare `m()` binds only when exactly ONE analyzed
-    # class (or module) defines an acquiring m — an ambiguous name like
-    # `close` (file close vs Logger.close) must not fabricate edges.
+    cg = get_callgraph(project)
+    scans: dict[tuple[str, str], _FnInfo] = {}
+    for node_info in cg.functions.values():
+        fi, _vs = _scan_cached(
+            project, node_info.node, node_info.cls, node_info.rel
+        )
+        scans[node_info.key] = fi
+    infos = list(scans.values())
+
+    # One-level name-based closure (kept alongside the call-graph
+    # closure: it resolves `obj.m()` when m's unique definer is the one
+    # acquiring class, which the stricter graph resolution declines).
     by_class: dict[tuple[str | None, str], set[str]] = {}
     owners: dict[str, set[str | None]] = {}
     for info in infos:
@@ -264,6 +288,64 @@ def check_lock_order(project: Project) -> list[Violation]:
             for taken in targets:
                 if taken != held:
                     edges.setdefault((held, taken), (info.rel, line))
+
+    # Whole-program closure: effective acquires per function = own
+    # acquires ∪ every callee's effective acquires (fixpoint over the
+    # call graph, per-edge `allow(lock-order)` escape honored).
+    callee_map: dict[tuple[str, str], list] = {
+        key: list(cg.callees(cg.functions[key], "lock-order"))
+        for key in scans
+    }
+    eff: dict[tuple[str, str], set[str]] = {
+        key: set(fi.acquires) for key, fi in scans.items()
+    }
+    changed = True
+    while changed:
+        changed = False
+        for key, outgoing in callee_map.items():
+            mine = eff[key]
+            before = len(mine)
+            for target, _line in outgoing:
+                mine.update(eff.get(target.key, ()))
+            if len(mine) != before:
+                changed = True
+
+    # a call at line L made while holding H adds H → eff(callee)
+    for key, fi in scans.items():
+        held_at: dict[int, set[str]] = {}
+        for held, _kind, _callee, line in fi.calls_under:
+            held_at.setdefault(line, set()).add(held)
+        if not held_at:
+            continue
+        for target, line in callee_map[key]:
+            for h in held_at.get(line, ()):
+                for taken in eff.get(target.key, ()):
+                    if taken != h:
+                        edges.setdefault((h, taken), (fi.rel, line))
+    project._lock_edges = edges
+    return edges
+
+
+def build_lock_graph(project: Project) -> dict:
+    """JSON-able static lock graph for the runtime sanitizer: every
+    predicted holds-while-acquiring edge plus provenance.  Exposed via
+    ``python -m tools.analysis --emit-lock-graph`` and consumed through
+    ``PILOSA_TPU_SANITIZE_STATIC`` (docs/concurrency.md)."""
+    edges = _collect_edges(project)
+    return {
+        "edges": sorted(
+            [a, b, f"{rel}:{line}"] for (a, b), (rel, line) in edges.items()
+        ),
+        "locks": sorted({n for pair in edges for n in pair}),
+    }
+
+
+@rule(
+    "lock-order",
+    "cycles in the holds-A-while-acquiring-B lock graph",
+)
+def check_lock_order(project: Project) -> list[Violation]:
+    edges = _collect_edges(project)
 
     graph: dict[str, set[str]] = {}
     for a, b in edges:

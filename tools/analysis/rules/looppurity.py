@@ -1,0 +1,148 @@
+"""Event-loop fast-path purity.
+
+PR 17's serving contract (docs/result-cache.md, docs/serving.md): a
+result-cache hit is answered ENTIRELY on the event loop — no worker
+dispatch, no admission ticket, no PQL parse.  That fast path is only a
+win while it stays fast: the loop thread must never wander into
+
+- **parsing** — any call edge into ``pql/`` (the parser + planner are
+  CPU work that belongs on the worker pool; the cache fast path exists
+  precisely to skip them);
+- **blocking I/O** — the same banned set ``asyncpurity`` enforces
+  (``time.sleep``, ``open``, raw sockets, ``subprocess``, thread
+  spawns);
+- **lock-holding code** — a ``with <lock>:`` / ``.acquire()`` reached
+  from the loop thread makes loop latency hostage to whatever worker
+  holds that lock.  The tolerated exceptions are the short, bounded,
+  loop-safe locks the fast path deliberately takes (result-cache LRU,
+  stats counters) — each carries ``# pilosa: allow(loop-purity)`` WITH
+  A REASON on the acquire line, and the runtime sanitizer verifies the
+  claim: those locks are registered ``loop_safe`` and every other lock
+  acquired on the loop thread is a finding
+  (``pilosa_tpu/utils/sanitize.py``, docs/concurrency.md).
+
+Roots: every ``async def`` in ``server/eventloop.py``.  The walk
+descends through sync callees only (each coroutine is its own root)
+and uses the shared call graph, so a lock taken three helpers below
+``_serve_cached`` is still flagged.  An ``allow(loop-purity)`` pragma
+on a CALL line cuts that edge (hand-off proven elsewhere); on a
+``with``/``acquire``/blocking line it blesses that fact.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.analysis.engine import Project, Violation, rule
+from tools.analysis.rules.asyncpurity import blocking_calls
+from tools.analysis.rules.locks import _lock_id
+
+_LOOP_FILE_SUFFIX = "server/eventloop.py"
+_PARSER_DIRS = ("pql/",)
+
+
+def _is_loop_file(rel: str) -> bool:
+    return rel == _LOOP_FILE_SUFFIX.split("/", 1)[1] or rel.endswith(
+        "/" + _LOOP_FILE_SUFFIX
+    ) or rel == _LOOP_FILE_SUFFIX
+
+
+def _in_parser(rel: str) -> bool:
+    return any(f"/{d}" in rel or rel.startswith(d) for d in _PARSER_DIRS)
+
+
+def _lock_facts(info) -> list[tuple[str, int]]:
+    """(lock id, line) for every lock-like `with` item or `.acquire()`
+    call in the function's own body."""
+    from tools.analysis.callgraph import _own_nodes
+
+    out: list[tuple[str, int]] = []
+    for node in _own_nodes(info.node):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                lid = _lock_id(item.context_expr, info.cls)
+                if lid is not None:
+                    out.append((lid, node.lineno))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "acquire"
+        ):
+            lid = _lock_id(node.func.value, info.cls)
+            if lid is not None:
+                out.append((lid, node.lineno))
+    return out
+
+
+@rule(
+    "loop-purity",
+    "the event-loop fast path must not reach parsing, blocking I/O, or locks",
+)
+def check_loop_purity(project: Project) -> list[Violation]:
+    from tools.analysis.callgraph import get_callgraph
+
+    cg = get_callgraph(project)
+    roots = [
+        fn
+        for fn in cg.functions.values()
+        if fn.is_async and _is_loop_file(fn.rel)
+    ]
+    if not roots:
+        return []
+
+    out: list[Violation] = []
+    flagged: set[tuple[str, int, str]] = set()
+
+    def emit(rel: str, line: int, msg: str) -> None:
+        key = (rel, line, msg)
+        if key not in flagged:
+            flagged.add(key)
+            out.append(Violation("loop-purity", rel, line, msg))
+
+    for root in roots:
+        reached = cg.reachable(
+            [root],
+            "loop-purity",
+            through=lambda fi: not fi.is_async and not _in_parser(fi.rel),
+        )
+        for key, path in reached.items():
+            target = cg.functions[key]
+            if target.is_async and path:
+                continue  # awaited coroutines are their own roots
+            via = (
+                " via " + " -> ".join(f"{t.qualname}()" for t, _ in path)
+                if path
+                else ""
+            )
+            # 1. the loop thread must never enter the parser
+            if path and _in_parser(target.rel):
+                edge_rel = path[-2][0].rel if len(path) >= 2 else root.rel
+                emit(
+                    edge_rel,
+                    path[-1][1],
+                    f"event-loop coroutine {root.qualname}() reaches the "
+                    f"parser ({target.qualname}() in {target.rel}){via} — "
+                    "cache hits must not parse; dispatch to the worker "
+                    "pool instead",
+                )
+                continue
+            # 2. blocking calls anywhere on the reachable surface
+            for name, why, line in blocking_calls(target.node):
+                emit(
+                    target.rel,
+                    line,
+                    f"blocking call {name}() reachable from event-loop "
+                    f"coroutine {root.qualname}(){via} — {why}",
+                )
+            # 3. lock acquisition anywhere on the reachable surface
+            for lid, line in _lock_facts(target):
+                emit(
+                    target.rel,
+                    line,
+                    f"lock {lid} acquired on the event-loop thread "
+                    f"(reachable from {root.qualname}(){via}) — loop "
+                    "latency becomes hostage to the lock holder; keep it "
+                    "only if loop_safe + bounded, and say why in the "
+                    "pragma",
+                )
+    return out
