@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze sanitize bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache
+.PHONY: check fix test analyze sanitize bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache bench-multiproc
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -70,3 +70,6 @@ bench-workload:
 # on never-repeating shapes (exits non-zero past 1.03x)
 bench-cache:
 	set -o pipefail; PILOSA_BENCH_ALL_CHILD=cache python bench_all.py | tee BENCH_CACHE_r17.json
+
+bench-multiproc:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=multiproc python bench_all.py | tee BENCH_MULTIPROC_r19.json

@@ -3059,6 +3059,193 @@ def _multichip_child(n_dev: int):
     )
 
 
+def config_multiproc():
+    """ISSUE 19: shard-owning multi-process serving (docs/
+    multiprocess.md).  QPS of the config8 count shape swept over
+    ``--processes`` 1/2/3 behind one public port, plus per-process
+    ratios and a bit-equivalence check of the config8 mix through the
+    3-process topology vs solo.  Hardware-aware like the multichip
+    sweep: on a host with fewer cores than processes the N children
+    TIME-SHARE the cores, so no speedup is physically possible — the
+    throughput gate is recorded as waived and the row set still gates
+    on correctness shapes (equivalence) and records the measured
+    ratios."""
+    import signal
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.request
+
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+
+    cores = os.cpu_count() or 1
+    sweep = (1, 2, 3)
+    duration_s = float(os.environ.get("PILOSA_BENCH_MULTIPROC_SECONDS", "4"))
+    clients = int(os.environ.get("PILOSA_BENCH_MULTIPROC_CLIENTS", "8"))
+
+    def call(port, method, path, body=None, timeout=120):
+        data = (
+            body
+            if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode()
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return json.loads(resp.read() or b"{}")
+
+    def wait_ready(port, deadline=600.0):
+        t0 = time.time()
+        while time.time() - t0 < deadline:
+            try:
+                if call(port, "GET", "/status", timeout=5)["state"] == "NORMAL":
+                    return
+            except OSError:
+                pass
+            except Exception:  # noqa: BLE001 - URLError during boot
+                pass
+            time.sleep(0.5)
+        raise TimeoutError(f"fleet on :{port} never NORMAL")
+
+    def load(port):
+        rng = np.random.default_rng(19)
+        n_shards, n = 6, 20000
+        call(port, "POST", "/index/i", {})
+        call(port, "POST", "/index/i/field/cab", {})
+        call(port, "POST", "/index/i/field/pc", {})
+        cols = rng.choice(n_shards * SHARD_WIDTH, n, replace=False)
+        cab = rng.integers(0, 256, n)
+        pc = rng.integers(1, 7, n)
+        for field, rows in (("cab", cab), ("pc", pc)):
+            call(
+                port, "POST", f"/index/i/field/{field}/import",
+                {"rowIDs": [int(r) for r in rows],
+                 "columnIDs": [int(c) for c in cols]},
+                timeout=600,
+            )
+
+    # the config8 mix: the three dashboard shapes
+    queries = {
+        "count": (
+            b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
+            b" Row(cab=4), Row(cab=5), Row(cab=6)))"
+        ),
+        "topn": b"TopN(cab, n=10)",
+        "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+    }
+
+    results_by_p = {}
+    qps_by_p = {}
+    for n_proc in sweep:
+        (public,) = free_ports(1)
+        tmp = tempfile.mkdtemp()
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS=os.environ.get("JAX_PLATFORMS", "cpu"),
+            XLA_FLAGS="",
+            PILOSA_TPU_ANTI_ENTROPY_INTERVAL="0",
+            PILOSA_TPU_DIAGNOSTICS_INTERVAL="0",
+            PILOSA_TPU_MAX_WRITES_PER_REQUEST="500000",
+        )
+        sup = subprocess.Popen(
+            [
+                sys.executable, "-m", "pilosa_tpu", "server",
+                "--processes", str(n_proc),
+                "--bind", f"127.0.0.1:{public}",
+                "--data-dir", tmp,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            wait_ready(public)
+            load(public)
+            results_by_p[n_proc] = {
+                name: call(public, "POST", "/index/i/query", q)["results"]
+                for name, q in queries.items()
+            }
+            # closed-loop count QPS over real concurrent clients
+            stop = time.time() + duration_s
+            done = [0] * clients
+
+            def worker(k):
+                while time.time() < stop:
+                    call(public, "POST", "/index/i/query", queries["count"])
+                    done[k] += 1
+
+            # warm each member's compile caches before the clock
+            for _ in range(4 * n_proc):
+                call(public, "POST", "/index/i/query", queries["count"])
+            threads = [
+                threading.Thread(target=worker, args=(k,))
+                for k in range(clients)
+            ]
+            t0 = time.time()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            qps = sum(done) / max(time.time() - t0, 1e-9)
+            qps_by_p[n_proc] = qps
+        finally:
+            if sup.poll() is None:
+                sup.send_signal(signal.SIGTERM)
+                try:
+                    sup.wait(timeout=60)
+                except subprocess.TimeoutExpired:
+                    sup.kill()
+                    sup.wait(timeout=30)
+
+    waiver = None
+    if cores < max(sweep):
+        waiver = (
+            f"waived: {cores} host cores < {max(sweep)} processes — "
+            "children time-share the cores, no speedup physically "
+            "possible; gating on correctness shapes and recording ratios"
+        )
+    base = qps_by_p[1]
+    for n_proc in sweep:
+        extra = {"processes": n_proc, "clients": clients}
+        if waiver and n_proc > cores:
+            extra["gate"] = waiver
+        line(
+            f"multiproc_count_qps_p{n_proc}",
+            qps_by_p[n_proc],
+            "q/s",
+            qps_by_p[n_proc] / base if base else 0.0,
+            extra,
+        )
+        if n_proc > 1:
+            # per-process efficiency: 1.0 = perfect scale-out
+            ratio = (qps_by_p[n_proc] / n_proc) / (base or 1.0)
+            extra2 = {"processes": n_proc}
+            if waiver and n_proc > cores:
+                extra2["gate"] = waiver
+            line(
+                f"multiproc_per_process_ratio_p{n_proc}",
+                ratio, "x", ratio, extra2,
+            )
+    # the correctness gate never waives: the full mix must be
+    # bit-identical through every topology
+    for name in queries:
+        ok = all(
+            results_by_p[p][name] == results_by_p[1][name] for p in sweep
+        )
+        line(
+            f"multiproc_equiv_{name}",
+            1.0 if ok else 0.0,
+            "bool",
+            1.0,
+            {"gate": "hard: bit-equivalence solo vs multi-process"},
+        )
+        if not ok:
+            raise SystemExit(f"multiproc equivalence FAILED for {name}")
+    line("host_cpus", float(cores), "cores", 1.0)
+
+
 def transport_context(emit: bool = True):
     """The sync dispatch+readback RTT floor. On a tunneled (remote)
     accelerator every SYNC query pays this regardless of device work, so
@@ -3100,6 +3287,7 @@ CONFIGS = {
     "workload": config_workload,
     "cache": config_cache,
     "profile": config_profile,
+    "multiproc": config_multiproc,
 }
 
 

@@ -90,7 +90,6 @@ def _apply_skip_verify(args) -> None:
 
 
 def cmd_server(args) -> int:
-    from pilosa_tpu.server import Server
     from pilosa_tpu.utils.config import load_config
 
     cfg = load_config(
@@ -101,11 +100,36 @@ def cmd_server(args) -> int:
             "coordinator": args.coordinator or None,
             "seeds": args.seeds.split(",") if args.seeds else None,
             "replica_n": args.replica_n,
+            "serving_processes": args.processes,
             "tls_certificate": args.tls_certificate,
             "tls_key": args.tls_key,
             "tls_skip_verify": args.tls_skip_verify or None,
         },
     )
+    if cfg.serving_processes > 1:
+        # multi-process serving (docs/multiprocess.md): the parent is a
+        # SUPERVISOR — spawn/watch/drain N child servers sharing the
+        # public port. Deliberately before any jax touch: the parent is
+        # a lifecycle manager and must stay light (the children each
+        # pay backend init; N+1 would be pure waste on a shared box).
+        # CLI flags that override the config file travel to children as
+        # env (argv keeps only per-child bind/data-dir/config).
+        from pilosa_tpu.server.supervisor import Supervisor
+
+        passthrough = {}
+        for key in ("tls_certificate", "tls_key"):
+            value = getattr(args, key)
+            if value is not None:
+                passthrough[key] = value
+        if args.tls_skip_verify:
+            passthrough["tls_skip_verify"] = "1"
+        sup = Supervisor(
+            cfg, config_path=args.config, argv_overrides=passthrough
+        )
+        return sup.run_forever()
+    _apply_jax_platform_env()
+    from pilosa_tpu.server import Server
+
     srv = Server(cfg)
     srv.open()
     print(f"pilosa-tpu server listening on {srv.uri}", flush=True)
@@ -351,21 +375,17 @@ def cmd_replay(args) -> int:
     return 0 if report["divergence"] == 0 else 1
 
 
-def cmd_doctor(args) -> int:
-    """Snapshot the ENTIRE debug surface of a live node into one JSON
-    bundle for offline diagnosis (docs/profiling.md): walks the
-    directory served by ``GET /debug/`` — so a debug endpoint added to
-    the server is collected with no doctor change — plus the core
-    status/info/metrics routes.  Endpoints that fail are recorded as
-    errors, not fatal: a half-dead node is exactly when a bundle is
-    wanted."""
-    _apply_skip_verify(args)
-    root = _base_uri(args.host)
+def _doctor_node_bundle(root: str, host_label: str, timeout: float) -> dict:
+    """One node's full debug-surface bundle: the core routes plus a
+    walk of the directory served by ``GET /debug/`` (so a debug
+    endpoint added to the server is collected with no doctor change).
+    Endpoints that fail are recorded as errors, not fatal: a half-dead
+    node is exactly when a bundle is wanted."""
 
     def fetch(path: str, is_json: bool):
         req = urllib.request.Request(root + path)
         with urllib.request.urlopen(
-            req, context=_SSL_CTX, timeout=args.timeout
+            req, context=_SSL_CTX, timeout=timeout
         ) as resp:
             raw = resp.read()
             ctype = resp.headers.get("Content-Type", "")
@@ -377,7 +397,7 @@ def cmd_doctor(args) -> int:
             return json.loads(raw or b"{}")
         return {"text": raw.decode(errors="replace")}
 
-    bundle: dict = {"host": args.host, "endpoints": {}}
+    bundle: dict = {"host": host_label, "endpoints": {}}
     errors = 0
 
     def collect(path: str, is_json: bool) -> None:
@@ -406,6 +426,32 @@ def cmd_doctor(args) -> int:
             continue
         collect(ep["path"] + q, bool(ep.get("json", True)))
     bundle["doctorErrors"] = errors
+    return bundle
+
+
+def cmd_doctor(args) -> int:
+    """Snapshot the ENTIRE debug surface of a live node into one JSON
+    bundle for offline diagnosis (docs/profiling.md).  With ``--fleet``
+    (docs/multiprocess.md), walk the node's ``/debug/processes`` view
+    and collect a full sub-bundle from every co-resident serving
+    process too — one command captures the whole multi-process box."""
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+    bundle = _doctor_node_bundle(root, args.host, args.timeout)
+    errors = bundle["doctorErrors"]
+    if args.fleet:
+        procs = bundle["endpoints"].get("/debug/processes") or {}
+        fleet: dict = {}
+        rows = procs.get("processes") if isinstance(procs, dict) else None
+        for row in rows or []:
+            uri = (row or {}).get("uri") or ""
+            if not uri or uri.rstrip("/") == root:
+                continue
+            sub = _doctor_node_bundle(uri.rstrip("/"), uri, args.timeout)
+            errors += sub["doctorErrors"]
+            fleet[uri] = sub
+        bundle["fleet"] = fleet
+        bundle["doctorErrors"] = errors
     out = json.dumps(bundle, indent=None if args.compact else 2)
     if args.out:
         with open(args.out, "w") as f:
@@ -474,7 +520,11 @@ def cmd_inspect(args) -> int:
 
 
 def main(argv: list[str] | None = None) -> int:
-    _apply_jax_platform_env()
+    # the JAX platform pin happens inside the commands that actually
+    # initialize a backend (cmd_server's solo path) — client-side
+    # commands and the multi-process supervisor parent never import
+    # jax, so `pilosa_tpu doctor` answers in milliseconds and the
+    # supervisor stays a light lifecycle manager (docs/multiprocess.md)
     p = argparse.ArgumentParser(prog="pilosa-tpu")
     sub = p.add_subparsers(dest="cmd", required=True)
 
@@ -485,6 +535,15 @@ def main(argv: list[str] | None = None) -> int:
     s.add_argument("--coordinator", action="store_true")
     s.add_argument("--seeds", default=None, help="comma-separated peer URIs")
     s.add_argument("--replica-n", type=int, default=None)
+    s.add_argument(
+        "--processes",
+        type=int,
+        default=None,
+        metavar="N",
+        help="multi-process serving (config serving-processes): run N "
+             "shard-owning child servers sharing the public port via "
+             "SO_REUSEPORT (docs/multiprocess.md)",
+    )
     s.add_argument("--tls-certificate", default=None, help="PEM cert; serves HTTPS")
     s.add_argument("--tls-key", default=None, help="PEM private key")
     s.add_argument(
@@ -585,6 +644,10 @@ def main(argv: list[str] | None = None) -> int:
                    help="trust self-signed server certificates")
     s.add_argument("--out", default=None, metavar="FILE",
                    help="write the bundle here instead of stdout")
+    s.add_argument("--fleet", action="store_true",
+                   help="multi-process box: also bundle every "
+                        "co-resident serving process listed by "
+                        "/debug/processes (docs/multiprocess.md)")
     s.add_argument("--timeout", type=float, default=15.0,
                    help="per-endpoint timeout seconds")
     s.add_argument("--compact", action="store_true",

@@ -67,6 +67,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/vars$"), "debug_vars"),
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
     ("GET", re.compile(r"^/debug/saturation$"), "debug_saturation"),
+    ("GET", re.compile(r"^/debug/processes$"), "debug_processes"),
     ("GET", re.compile(r"^/debug/resources$"), "debug_resources"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
@@ -99,6 +100,7 @@ _DEBUG_ENDPOINTS: list[tuple[str, str, bool, str | None]] = [
     ("/debug/vars", "counters/gauges/histograms plus per-subsystem state snapshots", True, ""),
     ("/debug/profile", "continuous profiler: folded flame-graph stacks (?seconds=N, ?segment=, ?format=speedscope|segments)", False, "?format=speedscope"),
     ("/debug/saturation", "USE verdict: event-loop lag, worker utilization, GIL estimate, lock contention (?window=S)", True, ""),
+    ("/debug/processes", "multi-process fleet view: supervisor state + per-process saturation verdicts stitched over localhost (?window=S)", True, ""),
     ("/debug/resources", "unified per-subsystem used/limit/pressure resource ledger", True, ""),
     ("/debug/flightrec", "retained slow/errored query evidence (?trace_id=, &format=perfetto)", True, ""),
     ("/debug/workload", "heavy-hitter fingerprints + cachability estimate (?top=, ?format=capture)", True, ""),
@@ -1056,6 +1058,108 @@ class Handler(BaseHTTPRequestHandler):
             )
         )
 
+    def h_debug_processes(self) -> None:
+        """The multi-process fleet view (docs/multiprocess.md): the
+        supervisor's state file (sharing mode, child pids, restart
+        counts) stitched with every co-resident process's LIVE
+        ``/debug/saturation`` verdict fetched over localhost.  Served
+        by every child, so a client hitting the shared public port gets
+        the whole fleet no matter which process the kernel picked; on
+        an unsupervised node the view degrades to per-cluster-node
+        verdicts (same stitch, no parent metadata).  ``?window=S``
+        forwards to each saturation report (default 60)."""
+        window = self.query_params.get("window", ["60"])[0]
+        float(window)  # validate before forwarding into the fleet
+        out: dict = {"supervised": False, "processes": []}
+        state = None
+        state_path = getattr(self.server, "supervisor_state_path", None)
+        if state_path:
+            try:
+                with open(state_path) as f:
+                    state = json.load(f)
+            except (OSError, ValueError) as e:
+                out["stateError"] = repr(e)
+        if state:
+            out["supervised"] = True
+            for key in ("mode", "publicBind", "publicUri", "parentPid"):
+                if key in state:
+                    out[key] = state[key]
+            members = state.get("processes", [])
+        else:
+            members = [
+                {"uri": n.get("uri"), "id": n.get("id")}
+                for n in self.api.hosts()
+            ]
+        for m in members:
+            row = {
+                k: m[k]
+                for k in (
+                    "index", "id", "uri", "bind", "pid", "ready",
+                    "restarts", "lastExitCode",
+                )
+                if k in m
+            }
+            uri = m.get("uri") or ""
+            if not uri:
+                # solo node with no cluster: report the local verdict
+                mon = getattr(self.server, "saturation", None)
+                if mon is not None:
+                    rep = mon.report(
+                        window_s=float(window),
+                        serving=self.server.serving_snapshot(),
+                    )
+                    row.update(self._saturation_digest(rep))
+                out["processes"].append(row)
+                continue
+            try:
+                rep = self._fetch_fleet_json(
+                    f"{uri}/debug/saturation?window={window}"
+                )
+                row.update(self._saturation_digest(rep))
+            except Exception as e:  # pilosa: allow(broad-except) — the
+                # fleet view's JOB includes naming which process could
+                # not answer (a crashed child mid-restart is the
+                # interesting row, not a reason to 500 the whole view)
+                row["error"] = repr(e)
+            out["processes"].append(row)
+        self._json(snapshot_envelope(out))
+
+    @staticmethod
+    def _saturation_digest(rep: dict) -> dict:
+        """The per-process slice of a /debug/saturation report the
+        fleet view stitches: verdict + pressures + sharing mode, not
+        the full probe histograms (doctor bundles those per node)."""
+        digest = {
+            "binding": rep.get("binding"),
+            "verdict": rep.get("verdict"),
+            "pressures": rep.get("pressures"),
+            "sharedListener": (rep.get("serving") or {}).get(
+                "sharedListener"
+            ),
+            "connectionsOpen": (rep.get("serving") or {}).get(
+                "connectionsOpen"
+            ),
+        }
+        if "recommendation" in rep:
+            digest["recommendation"] = rep["recommendation"]
+        return digest
+
+    def _fetch_fleet_json(self, url: str, timeout: float = 5.0) -> dict:
+        import ssl
+        import urllib.request
+
+        ctx = None
+        if url.startswith("https://"):
+            # co-resident children share the node's own (often self-
+            # signed) certificate — verification adds nothing on
+            # localhost and would break the default TLS recipe
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        req = urllib.request.Request(url)
+        with urllib.request.urlopen(req, timeout=timeout, context=ctx) as r:
+            return json.loads(r.read() or b"{}")
+
     def h_debug_resources(self) -> None:
         """The unified resource ledger (docs/profiling.md): the byte
         accounting scattered across the codebase — device residency
@@ -1503,6 +1607,10 @@ class _ServerCore:
         # structured JSON access log (config access-log-format=json);
         # off by default — the access-log emitter checks this flag
         self.access_log_json = False
+        # multi-process fleet state (docs/multiprocess.md): the runtime
+        # Server points this at the supervisor's state file so GET
+        # /debug/processes can stitch the fleet; None = unsupervised
+        self.supervisor_state_path = None
         self.extra_routes: dict = {}
         # sync queries land in the API façade, which hands them to the
         # cross-query wave scheduler (api.scheduler) instead of calling

@@ -129,6 +129,16 @@ class Server:
         backlog for the full client timeout instead of getting an instant
         connection-refused — concurrent cold starts then stack 30s
         timeouts on each other."""
+        if (
+            self.config.shared_bind or self.config.fd_pass_socket
+        ) and self.config.serving_mode == "threaded":
+            # refuse BEFORE any background thread starts: a misconfig
+            # raising mid-open would leak profiler/saturation threads
+            raise ValueError(
+                "multi-process serving (shared-bind / fd-pass-socket) "
+                "requires serving-mode = \"event\" — the threaded "
+                "listener has no shared-listener support"
+            )
         if self.fs_fault_injector.armed:
             # before holder.open(): crash-recovery rehearsals target the
             # load path (snapshot reads, torn-tail truncation) too
@@ -264,6 +274,14 @@ class Server:
         self.http.fs_fault_injector = self.fs_fault_injector
         self.http.log = self.logger.log
         self.http.gate = self._query_gate
+        # multi-process fleet state (docs/multiprocess.md): a supervised
+        # child reads the supervisor's state file to serve the stitched
+        # GET /debug/processes view
+        self.http.supervisor_state_path = (
+            os.path.expanduser(self.config.supervisor_state)
+            if self.config.supervisor_state
+            else None
+        )
         if self.config.seeds or self.config.coordinator:
             from pilosa_tpu.parallel.cluster import Cluster
 
@@ -304,6 +322,26 @@ class Server:
         self._mesh_attach_thread = t
         if self.cluster is not None:
             self.cluster.join()
+        # multi-process serving (docs/multiprocess.md): join the shared
+        # public port only NOW — after the cluster join has completed —
+        # so the kernel (reuseport) or the parent (fd-pass) never routes
+        # a public connection to a child that cannot serve its shard
+        # subset yet (readiness gating before the port is announced)
+        if self.config.shared_bind:
+            host, _, port = self.config.shared_bind.rpartition(":")
+            self.http.add_shared_listener(host, int(port))
+            self.logger.log(
+                "shared public listener bound via SO_REUSEPORT on "
+                f"{self.config.shared_bind}"
+            )
+        if self.config.fd_pass_socket:
+            self.http.add_fd_listener(
+                os.path.expanduser(self.config.fd_pass_socket)
+            )
+            self.logger.log(
+                "adopting accept-and-pass connections from "
+                f"{self.config.fd_pass_socket}"
+            )
         self._schedule_anti_entropy()
         from pilosa_tpu.server.diagnostics import DiagnosticsCollector
 

@@ -263,6 +263,33 @@ class Config:
     # (method, route, status, latency, bytes, trace id, fingerprint)
     # to the server log sink; "" disables (the default)
     access_log_format: str = ""
+    # multi-process serving (docs/multiprocess.md): N > 1 turns
+    # `pilosa_tpu server` into a SUPERVISOR that spawns N child server
+    # processes sharing the public port via SO_REUSEPORT (accept-and-
+    # pass fallback where the option is missing), each child owning a
+    # disjoint shard subset through ordinary cluster membership over
+    # localhost — the one-process GIL/worker-pool ceiling becomes
+    # horizontal headroom. 1 (the default) serves in-process as before.
+    serving_processes: int = 1
+    # supervisor→child plumbing (the supervisor sets these for its
+    # children; operators only need them for hand-built topologies):
+    # an EXTRA public host:port this child binds with SO_REUSEPORT once
+    # its cluster join completes — readiness gating: the shared port
+    # never routes to a child that cannot serve its shard subset yet
+    shared_bind: str = ""
+    # unix-socket path where an accept-and-pass parent delivers
+    # accepted public connections as SCM_RIGHTS fds; the child adopts
+    # each into its event loop (the no-SO_REUSEPORT fallback)
+    fd_pass_socket: str = ""
+    # path of the supervisor's fleet-state JSON (listener mode, child
+    # pids, restart counts) — children read it to serve the stitched
+    # GET /debug/processes fleet view
+    supervisor_state: str = ""
+    # restart-on-crash backoff: the first respawn of a crashed child
+    # waits base seconds, doubling per consecutive crash up to max
+    # (a child that stays up resets the streak)
+    supervisor_restart_backoff_s: float = 0.5
+    supervisor_restart_backoff_max_s: float = 10.0
     # metrics
     metric_service: str = "prometheus"  # prometheus | statsd | none
     statsd_host: str = ""  # host:port for metric_service = "statsd"
@@ -418,6 +445,12 @@ def config_template() -> str:
         'result-cache-mode = "on"\n'
         'slo-targets = ""\n'
         'access-log-format = ""\n'
+        "serving-processes = 1\n"
+        'shared-bind = ""\n'
+        'fd-pass-socket = ""\n'
+        'supervisor-state = ""\n'
+        "supervisor-restart-backoff-s = 0.5\n"
+        "supervisor-restart-backoff-max-s = 10.0\n"
         'metric-service = "prometheus"\n'
         'statsd-host = ""\n'
         'tls-certificate = ""\n'

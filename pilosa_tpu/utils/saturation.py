@@ -32,6 +32,7 @@ multi-process PR (ROADMAP item 3) is sized from.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -331,8 +332,33 @@ class SaturationMonitor:
             else f"{binding} is the binding resource "
                  f"(pressure {pressures[binding]:.2f})"
         )
+        # scale-out recommendation (docs/multiprocess.md): worker-pool
+        # and GIL pressure are PER-INTERPRETER ceilings — more threads
+        # cannot help, more processes can.  Name the remedy and size it
+        # from the host's cores; on a core-starved box the suggestion
+        # is recorded but waived, since N processes would time-share
+        # the same core (the bench's MULTICHIP_r06 waiver precedent).
+        recommendation = None
+        if binding in ("worker-pool", "gil"):
+            cores = os.cpu_count() or 1
+            recommendation = {
+                "remedy": "serving-processes",
+                "why": (
+                    f"{binding} saturation is per-process: N shard-"
+                    "owning server processes multiply both lanes "
+                    "(docs/multiprocess.md)"
+                ),
+                "hostCores": cores,
+                "suggestedProcesses": max(2, min(cores, 8)),
+            }
+            if cores < 2:
+                recommendation["gate"] = (
+                    f"waived: {cores} core — serving processes would "
+                    "time-share it; the remedy applies on a multi-core "
+                    "host"
+                )
         ms = lambda s: round(s * 1e3, 3)
-        return {
+        out = {
             "enabled": self.enabled,
             "probesStarted": self._started,
             "windowSeconds": window_s,
@@ -364,6 +390,9 @@ class SaturationMonitor:
             "binding": binding,
             "verdict": verdict,
         }
+        if recommendation is not None:
+            out["recommendation"] = recommendation
+        return out
 
 
 # ------------------------------------------------------------- process RSS

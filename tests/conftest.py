@@ -75,6 +75,13 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "multiproc: shard-owning multi-process serving suite — supervisor "
+        "lifecycle, SO_REUSEPORT/fd-pass listeners, fleet observability "
+        "(tests/test_multiproc.py; the in-process half runs in tier-1, "
+        "the subprocess topologies are also marked slow)",
+    )
+    config.addinivalue_line(
+        "markers",
         "observability: flight recorder / EXPLAIN / router-audit suite "
         "(tests/test_flightrec.py; runs in tier-1 — the marker exists so "
         "`pytest -m observability` scopes to it)",
