@@ -2,7 +2,7 @@
 # by the artifact tee
 SHELL := /bin/bash
 
-.PHONY: check fix test analyze sanitize bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache bench-multiproc
+.PHONY: check fix test analyze sanitize bench-ingest bench-residency bench-observability bench-workload bench-profile bench-cache bench-multiproc bench-resize
 
 # the same gate CI runs: repo analyzer, then ruff/mypy when installed
 check:
@@ -73,3 +73,12 @@ bench-cache:
 
 bench-multiproc:
 	set -o pipefail; PILOSA_BENCH_ALL_CHILD=multiproc python bench_all.py | tee BENCH_MULTIPROC_r19.json
+
+# live elastic resize under fire (docs/resize.md): 2→3→2 while the
+# recorded config8 mix replays + paced bulk ingest streams frames;
+# exits non-zero on any failed/diverged query, broken convergence
+# (survivor checksums / acked ingest bits), or acknowledged loss in
+# the kill-9 mid-pull chaos leg; p95 and movement-rate gates are
+# hardware-aware (waived-and-recorded on a core-starved box)
+bench-resize:
+	set -o pipefail; PILOSA_BENCH_ALL_CHILD=resize python bench_all.py | tee BENCH_RESIZE_r20.json

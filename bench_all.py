@@ -3246,6 +3246,421 @@ def config_multiproc():
     line("host_cpus", float(cores), "cores", 1.0)
 
 
+def config_resize():
+    """ISSUE 20: live elastic resize under fire (docs/resize.md).  A
+    2-node in-process cluster (replica_n=2) over real HTTP sockets
+    grows to 3 nodes and shrinks back to 2 while (a) the recorded
+    config8 mix (count:topn:groupby 8:3:1, captured from the live
+    workload plane) REPLAYS against the coordinator at a fixed offered
+    rate and (b) a paced bulk-ingest client streams roaring frames to
+    /import-roaring, honoring 429/Retry-After.  All movement —
+    hydration pulls on the joiner, re-pulls after the remove — rides
+    the movement admission lane and is read off its meter.
+
+    GATES (exit non-zero):
+      - HARD zero failed queries: every replay batch through both
+        transitions completes with errorRate 0, zero transport
+        failures, zero status divergence;
+      - HARD convergence: after the shrink + anti-entropy, the two
+        survivors' /internal/status fragment checksums agree exactly,
+        and every acked ingest bit is countable from both;
+      - resize-window p95 <= 2x steady-state p95 — hardware-aware like
+        the multiproc sweep: on a host with <3 cores the joiner's
+        pull work TIME-SHARES the serving core, so the gate is
+        recorded as waived with the measured ratio;
+      - movement pull Mbit/s >= the r14 bulk-ingest rate, same waiver
+        on a core-starved box (recorded either way);
+      - kill-9 mid-fragment-pull (tests/_movement_child.py) loses
+        ZERO acknowledged writes — always hard."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    import threading
+    import urllib.error
+    import urllib.request
+
+    from pilosa_tpu.roaring import shard_payloads
+    from pilosa_tpu.server import Server
+    from pilosa_tpu.shardwidth import SHARD_WIDTH
+    from pilosa_tpu.utils import workload as wlmod
+    from pilosa_tpu.utils.config import Config
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    cores = os.cpu_count() or 1
+    n_shards = 6
+    qps = float(os.environ.get("PILOSA_BENCH_RESIZE_QPS", "12"))
+    mix_rounds = int(os.environ.get("PILOSA_BENCH_RESIZE_MIX_ROUNDS", "10"))
+    ingest_bits = 2048
+    ingest_period = 0.25
+    failed = False
+
+    def call(port, method, path, body=None, raw=False, timeout=120):
+        data = (
+            body
+            if isinstance(body, (bytes, type(None)))
+            else json.dumps(body).encode()
+        )
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data, method=method
+        )
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            payload = resp.read()
+            return payload if raw else json.loads(payload or b"{}")
+
+    tmp = tempfile.mkdtemp()
+    ports = free_ports(2)
+    seeds = [f"http://127.0.0.1:{p}" for p in ports]
+
+    def make_node(i, port, node_seeds):
+        cfg = Config(
+            bind=f"127.0.0.1:{port}",
+            data_dir=f"{tmp}/node{i}",
+            seeds=node_seeds,
+            replica_n=2,
+            anti_entropy_interval=0,
+            coordinator=(i == 0),
+            max_writes_per_request=500_000,
+        )
+        s = Server(cfg)
+        s.open()
+        return s
+
+    servers = [make_node(i, p, seeds) for i, p in enumerate(ports)]
+    new_srv = None
+    try:
+        for s in servers:
+            s.wait_mesh(60)
+            s.cluster._heartbeat_once()
+
+        # ---- the config8 dataset + mix, captured off the live plane
+        rng = np.random.default_rng(20)
+        n = 60_000
+        call(ports[0], "POST", "/index/rz", {})
+        call(ports[0], "POST", "/index/rz/field/cab", {})
+        call(ports[0], "POST", "/index/rz/field/pc", {})
+        cols = rng.choice(n_shards * SHARD_WIDTH, n, replace=False)
+        for field, rows in (
+            ("cab", rng.integers(0, 256, n)),
+            ("pc", rng.integers(1, 7, n)),
+        ):
+            for lo in range(0, n, 20_000):
+                call(
+                    ports[0], "POST", f"/index/rz/field/{field}/import",
+                    {"rowIDs": [int(r) for r in rows[lo:lo + 20_000]],
+                     "columnIDs": [int(c) for c in cols[lo:lo + 20_000]]},
+                    timeout=600,
+                )
+        queries = {
+            "count": (
+                b"Count(Union(Row(cab=1), Row(cab=2), Row(cab=3),"
+                b" Row(cab=4), Row(cab=5), Row(cab=6)))"
+            ),
+            "topn": b"TopN(cab, n=10)",
+            "groupby": b"GroupBy(Rows(cab, limit=64), Rows(pc), limit=200)",
+        }
+        mix = []
+        for _ in range(mix_rounds):
+            batch = [
+                name
+                for name, w in {"count": 8, "topn": 3, "groupby": 1}.items()
+                for _ in range(w)
+            ]
+            rng.shuffle(batch)
+            mix.extend(batch)
+        for name in mix:
+            call(ports[0], "POST", "/index/rz/query", queries[name])
+        capture = call(
+            ports[0], "GET", "/debug/workload?format=capture", raw=True
+        ).decode()
+        records = [json.loads(ln) for ln in capture.strip().splitlines()]
+        records = records[-len(mix):]
+
+        # ---- steady state: the same offered load, no movement
+        base0 = f"http://127.0.0.1:{ports[0]}"
+        steady = wlmod.replay(records, base0, qps=qps, workers=4)
+        line(
+            "resize_steady_p95_ms", steady["p95Ms"], "ms", 1.0,
+            {"p50_ms": steady["p50Ms"], "qps": steady["qps"],
+             "offered_qps": qps, "records": len(records)},
+        )
+
+        # ---- 2→3→2 under fire
+        resize_done = threading.Event()
+        timeline: dict = {}
+        ingest_stats = {"frames": 0, "bits": 0, "backoffs429": 0,
+                        "errors": []}
+        INGEST_ROW = 300  # outside the mix's cab row space (0..255)
+
+        def ingest_loop():
+            i = 0
+            while not resize_done.is_set():
+                shard = i % n_shards
+                base = (
+                    shard * SHARD_WIDTH
+                    + 200_000
+                    + (i // n_shards) * ingest_bits
+                )
+                icols = np.arange(base, base + ingest_bits, dtype=np.uint64)
+                irows = np.full(ingest_bits, INGEST_ROW, dtype=np.uint64)
+                sh, frame, nbits = shard_payloads(irows, icols)[0]
+                try:
+                    call(
+                        ports[0], "POST",
+                        f"/index/rz/field/cab/import-roaring/{sh}",
+                        frame, raw=True, timeout=120,
+                    )
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        # the pacing protocol, not an error (docs/ingest.md)
+                        ingest_stats["backoffs429"] += 1
+                        ra = float(e.headers.get("Retry-After") or 0.05)
+                        time.sleep(min(max(ra, 0.01), 5.0))
+                        continue  # retry the SAME frame
+                    ingest_stats["errors"].append(f"HTTP {e.code}")
+                except OSError as e:
+                    ingest_stats["errors"].append(f"{type(e).__name__}: {e}")
+                else:
+                    ingest_stats["frames"] += 1
+                    ingest_stats["bits"] += nbits
+                i += 1
+                time.sleep(ingest_period)
+
+        def do_resize():
+            nonlocal new_srv
+            try:
+                (new_port,) = free_ports(1)
+                t0 = time.monotonic()
+                new_srv = make_node(
+                    2, new_port, seeds + [f"http://127.0.0.1:{new_port}"]
+                )
+                new_srv.wait_mesh(60)
+                for s in [*servers, new_srv]:
+                    s.cluster.wait_rebalanced(300)
+                timeline["grow_s"] = time.monotonic() - t0
+                mv = new_srv.cluster.movement.meter.snapshot()
+                timeline["pull_bytes"] = mv["bytesByDirection"].get("pull", 0)
+                timeline["pull_fragments"] = mv["fragmentsTotal"]
+                time.sleep(1.0)  # serve a beat at 3 nodes, under fire
+                t1 = time.monotonic()
+                removed_id = new_srv.cluster.me.id
+                for attempt in range(20):
+                    try:
+                        call(
+                            ports[0], "POST",
+                            "/internal/cluster/resize/remove-node",
+                            {"id": removed_id},
+                        )
+                        break
+                    except urllib.error.HTTPError as e:
+                        if e.code != 409 or attempt == 19:
+                            raise  # only a pull-in-flight 409 is expected
+                        time.sleep(0.5)
+                for s in servers:
+                    s.cluster.wait_rebalanced(300)
+                timeline["shrink_s"] = time.monotonic() - t1
+            except Exception as e:  # noqa: BLE001 — gate in the main thread
+                timeline["error"] = repr(e)
+            finally:
+                resize_done.set()
+
+        rt = threading.Thread(target=do_resize, daemon=True)
+        it = threading.Thread(target=ingest_loop, daemon=True)
+        rt.start()
+        it.start()
+        fire_reports = []
+        while len(fire_reports) < 40:
+            fire_reports.append(
+                wlmod.replay(records, base0, qps=qps, workers=4)
+            )
+            if resize_done.is_set():
+                break
+        rt.join(timeout=600)
+        it.join(timeout=60)
+        if "error" in timeline:
+            failed = True
+            line("resize_transition_failed", 0.0, "error", 0.0,
+                 {"detail": timeline["error"]})
+
+        # ---- HARD: zero failed queries through both transitions
+        bad = sum(
+            r["transportFailures"]
+            + r["divergence"]
+            + round(r["errorRate"] * r["completed"])
+            + (r["records"] - r["completed"] - r["transportFailures"])
+            for r in fire_reports
+        )
+        sent = sum(r["records"] for r in fire_reports)
+        line(
+            "resize_failed_queries", float(bad), "queries", 0.0,
+            {"sent": sent, "batches": len(fire_reports),
+             "gate": "hard: zero failed/diverged queries during 2→3→2"},
+        )
+        if bad:
+            failed = True
+
+        # ---- resize-window p95 vs steady state
+        fire_p95 = max(r["p95Ms"] for r in fire_reports)
+        ratio = fire_p95 / max(steady["p95Ms"], 1e-9)
+        extra = {
+            "steady_p95_ms": steady["p95Ms"], "ratio": round(ratio, 3),
+            "grow_s": round(timeline.get("grow_s", 0.0), 3),
+            "shrink_s": round(timeline.get("shrink_s", 0.0), 3),
+        }
+        if ratio > 2.0:
+            if cores < 3:
+                extra["gate"] = (
+                    f"waived: {cores} host core(s) — the joiner's pull "
+                    "+ ingest + replay time-share the serving core, so "
+                    "latency isolation is not measurable here; gating "
+                    "on zero failed queries and recording the ratio"
+                )
+            else:
+                failed = True
+                extra["gate"] = "violated: p95 under resize > 2x steady"
+        line("resize_under_fire_p95_ms", fire_p95, "ms", ratio, extra)
+
+        # ---- movement throughput off the joiner's lane meter
+        pull_bytes = timeline.get("pull_bytes", 0)
+        grow_s = max(timeline.get("grow_s", 0.0), 1e-9)
+        mbits = pull_bytes * 8 / 1e6 / grow_s
+        r14_mbits = 10.0  # the bench-ingest gate floor, r14 measured 12.158
+        try:
+            with open(os.path.join(repo, "BENCH_INGEST_r14.json")) as fh:
+                for ln in fh:
+                    rec = json.loads(ln)
+                    if rec.get("metric") == (
+                        "ingest_bulk_sustained_msetbits_per_s"
+                    ):
+                        r14_mbits = rec["value"]
+                        break
+        except (OSError, ValueError):
+            pass
+        extra = {
+            "pull_bytes": pull_bytes,
+            "pull_fragments": timeline.get("pull_fragments", 0),
+            "grow_s": round(grow_s, 3),
+            "r14_bulk_rate": r14_mbits,
+        }
+        if mbits < r14_mbits:
+            if cores < 3:
+                extra["gate"] = (
+                    f"waived: {cores} host core(s) — hydration shares "
+                    "the core with the replayed mix + paced ingest (the "
+                    "r14 rate was a dedicated bulk lane); recorded, not "
+                    "gated"
+                )
+            else:
+                failed = True
+                extra["gate"] = "violated: movement slower than r14 bulk"
+        line("resize_movement_pull_mbits", mbits, "Mbit/s", 1.0, extra)
+
+        # ---- HARD: post-resize convergence (checksums + acked ingest)
+        if new_srv is not None:
+            new_srv.close()  # survivors finished re-pulling; now drop it
+            new_srv = None
+        for _ in range(2):
+            for s in servers:
+                s.cluster.sync_holder()
+        sums = [
+            call(p, "GET", "/internal/status")["checksums"].get("rz", {})
+            for p in ports
+        ]
+        converged = sums[0] == sums[1] and len(sums[0]) > 0
+        counts = [
+            call(p, "POST", "/index/rz/query",
+                 f"Count(Row(cab={INGEST_ROW}))".encode())["results"][0]
+            for p in ports
+        ]
+        ingest_exact = (
+            not ingest_stats["errors"]
+            and counts[0] == counts[1] == ingest_stats["bits"]
+        )
+        line(
+            "resize_converged", 1.0 if (converged and ingest_exact) else 0.0,
+            "bool", 1.0,
+            {"fragments": len(sums[0]),
+             "ingest_frames": ingest_stats["frames"],
+             "ingest_bits": ingest_stats["bits"],
+             "ingest_backoffs429": ingest_stats["backoffs429"],
+             "ingest_errors": ingest_stats["errors"][:5],
+             "counted": counts,
+             "gate": "hard: survivor checksums equal + every acked "
+                     "ingest bit countable from both"},
+        )
+        if not (converged and ingest_exact):
+            failed = True
+    finally:
+        for s in [*servers, new_srv]:
+            if s is not None:
+                s.close()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- kill-9 mid-fragment-pull: zero acknowledged loss (always hard)
+    child = os.path.join(repo, "tests", "_movement_child.py")
+    chaos_dir = tempfile.mkdtemp()
+    env = dict(os.environ, PILOSA_TPU_SHARD_WIDTH_EXP="16",
+               JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    rule = {"op": "wal-append", "action": "torn", "cap_bytes": 17,
+            "then": "kill", "path": "fragments/", "after": 0}
+    try:
+        proc = subprocess.run(
+            [sys.executable, child, f"{chaos_dir}/holder",
+             json.dumps([rule]), "pull"],
+            capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+        )
+        acked = [
+            int(ln.split()[1])
+            for ln in proc.stdout.splitlines()
+            if ln.startswith("ACK ")
+        ]
+        verify_src = (
+            "import json, sys\n"
+            "import numpy as np\n"
+            "from pilosa_tpu.core import Holder\n"
+            "h = Holder(sys.argv[1]); h.open()\n"
+            "frag = h.index('i').field('f').view('standard').fragment(0)\n"
+            "lost = 0\n"
+            "for b in json.loads(sys.argv[2]):\n"
+            "    for c in range(b * 8, (b + 1) * 8):\n"
+            "        if not frag.contains(b % 4, c):\n"
+            "            lost += 1\n"
+            "q = bool((frag.last_recovery or {}).get('quarantined', False))\n"
+            "print(json.dumps({'lost': lost, 'quarantined': q}))\n"
+            "h.close()\n"
+        )
+        check = subprocess.run(
+            [sys.executable, "-c", verify_src, f"{chaos_dir}/holder",
+             json.dumps(acked)],
+            capture_output=True, text=True, timeout=120, env=env, cwd=repo,
+        )
+        verdict = json.loads(check.stdout or '{"lost": -1}')
+        ok = (
+            proc.returncode == -9
+            and "ADOPTED" not in proc.stdout
+            and bool(acked)
+            and check.returncode == 0
+            and verdict["lost"] == 0
+            and not verdict.get("quarantined")
+        )
+        line(
+            "resize_kill9_midpull_acked_loss",
+            float(max(verdict.get("lost", -1), 0 if ok else 1)),
+            "bits", 0.0,
+            {"child_rc": proc.returncode, "acked_batches": len(acked),
+             "gate": "hard: SIGKILL mid-pull-adopt loses zero "
+                     "acknowledged writes"},
+        )
+        if not ok:
+            failed = True
+    finally:
+        shutil.rmtree(chaos_dir, ignore_errors=True)
+
+    line("host_cpus", float(cores), "cores", 1.0)
+    if failed:
+        sys.exit(1)
+
+
 def transport_context(emit: bool = True):
     """The sync dispatch+readback RTT floor. On a tunneled (remote)
     accelerator every SYNC query pays this regardless of device work, so
@@ -3288,6 +3703,7 @@ CONFIGS = {
     "cache": config_cache,
     "profile": config_profile,
     "multiproc": config_multiproc,
+    "resize": config_resize,
 }
 
 

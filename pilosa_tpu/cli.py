@@ -14,6 +14,8 @@ import json
 import os
 import signal
 import sys
+import time
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -74,6 +76,45 @@ def _http(method: str, url: str, body: bytes | None = None, ctype: str = "applic
         req.add_header("Content-Type", ctype)
     with urllib.request.urlopen(req, context=_SSL_CTX) as resp:
         return json.loads(resp.read() or b"{}")
+
+
+def _http_raw(method: str, url: str, body: bytes | None = None,
+              ctype: str = "application/octet-stream") -> bytes:
+    """Like _http but for octet-stream payloads (fragment frames)."""
+    req = urllib.request.Request(url, data=body, method=method)
+    if body is not None:
+        req.add_header("Content-Type", ctype)
+    with urllib.request.urlopen(req, context=_SSL_CTX) as resp:
+        return resp.read()
+
+
+_RESTORE_MAX_RETRIES_429 = 64
+
+
+def _post_with_backoff(url: str, body: bytes, ctype: str) -> dict:
+    """POST honoring 429/Retry-After (docs/resize.md): restore streams
+    whole-fragment frames through the public bulk lane, so it must yield
+    to admission control exactly like the loader — retry the SAME frame
+    (import-roaring union-adopt is idempotent) after the advertised
+    pause, bounded so a wedged server fails the restore instead of
+    hanging it."""
+    for _ in range(_RESTORE_MAX_RETRIES_429):
+        try:
+            raw = _http_raw("POST", url, body, ctype=ctype)
+            return json.loads(raw or b"{}")
+        except urllib.error.HTTPError as e:
+            if e.code != 429:
+                raise
+            try:
+                retry_after = float(e.headers.get("Retry-After") or 0.05)
+            except ValueError:
+                retry_after = 0.05
+            e.close()
+            time.sleep(min(max(retry_after, 0.01), 5.0))
+    raise RuntimeError(
+        f"restore: {url} still answering 429 after "
+        f"{_RESTORE_MAX_RETRIES_429} attempts"
+    )
 
 
 def _apply_skip_verify(args) -> None:
@@ -375,6 +416,232 @@ def cmd_replay(args) -> int:
     return 0 if report["divergence"] == 0 else 1
 
 
+def cmd_backup(args) -> int:
+    """Whole-index backup over the bulk lane (docs/resize.md).
+
+    Discovers the member list from ``GET /status``, takes a
+    checksum-stamped fragment inventory from every node, dedups by
+    (field, view, shard) — replicas carry identical serialized frames,
+    verified by content digest — then streams each unique fragment's
+    serialized roaring frame off a node that owns it via
+    ``/internal/fragment/data``.  The tar holds the schema dump, every
+    frame, and the translate stores (column + per keyed field), plus a
+    manifest with per-fragment checksums so restore can verify adoption.
+    """
+    import tarfile
+    import io as _io
+
+    from pilosa_tpu.parallel.movement import fragment_checksum
+
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+    index = args.index
+    status = _http("GET", root + "/status")
+    nodes = [
+        n["uri"].rstrip("/") for n in status.get("nodes") or [] if n.get("uri")
+    ] or [root]
+
+    schema = _http("GET", root + "/schema")
+    idx_def = next(
+        (i for i in schema.get("indexes", []) if i["name"] == index), None
+    )
+    if idx_def is None:
+        print(f"backup: index {index!r} not found on {root}", file=sys.stderr)
+        return 1
+
+    # one row per unique fragment; first owner wins, divergent replica
+    # checksums are surfaced (anti-entropy hasn't converged — the backup
+    # still proceeds with the first copy, verified below)
+    frags: dict[tuple[str, str, int], tuple[str, str]] = {}
+    divergent = 0
+    for uri in nodes:
+        try:
+            inv = _http(
+                "GET",
+                f"{uri}/internal/fragment/inventory?index={index}&checksums=1",
+            )
+        except (urllib.error.URLError, OSError) as e:
+            print(f"backup: skipping unreachable {uri}: {e}", file=sys.stderr)
+            continue
+        for row in inv.get("fragments", []):
+            key = (row["field"], row["view"], int(row["shard"]))
+            have = frags.get(key)
+            if have is None:
+                frags[key] = (row.get("checksum", ""), uri)
+            elif have[0] and row.get("checksum") and have[0] != row["checksum"]:
+                divergent += 1
+    if divergent:
+        print(
+            f"backup: WARNING {divergent} fragment(s) diverge across "
+            "replicas (anti-entropy pending); backing up first copy",
+            file=sys.stderr,
+        )
+
+    out_path = args.out or f"{index}.backup.tar"
+    manifest: dict = {
+        "formatVersion": 1,
+        "index": index,
+        "fragments": [],
+        "translate": {"columns": 0, "fields": {}},
+    }
+    total_bytes = 0
+    with tarfile.open(out_path, "w") as tar:
+
+        def put(name: str, data: bytes) -> None:
+            info = tarfile.TarInfo(f"{index}/{name}")
+            info.size = len(data)
+            tar.addfile(info, _io.BytesIO(data))
+
+        put(
+            "schema.json",
+            json.dumps({"indexes": [idx_def]}, indent=2).encode(),
+        )
+
+        for (field, view, shard), (checksum, uri) in sorted(frags.items()):
+            data = _http_raw(
+                "GET",
+                f"{uri}/internal/fragment/data?index={index}&field={field}"
+                f"&view={view}&shard={shard}",
+            )
+            actual = fragment_checksum(data)
+            if checksum and actual != checksum:
+                # a write landed between inventory and fetch — the frame
+                # is still internally consistent; record what we stored
+                checksum = actual
+            put(f"fragments/{field}/{view}/{shard}", data)
+            total_bytes += len(data)
+            manifest["fragments"].append({
+                "field": field,
+                "view": view,
+                "shard": shard,
+                "bytes": len(data),
+                "checksum": checksum,
+            })
+
+        def pull_translate(field: str | None) -> list:
+            qs = f"index={index}&offset=0"
+            if field:
+                qs += f"&field={field}"
+            resp = _http("GET", f"{root}/internal/translate/data?{qs}")
+            return [[e["k"], e["id"]] for e in resp.get("entries", [])]
+
+        if idx_def.get("options", {}).get("keys"):
+            entries = pull_translate(None)
+            put("translate/columns.json", json.dumps(entries).encode())
+            manifest["translate"]["columns"] = len(entries)
+        for f_def in idx_def.get("fields", []):
+            if f_def.get("options", {}).get("keys"):
+                entries = pull_translate(f_def["name"])
+                put(
+                    f"translate/field-{f_def['name']}.json",
+                    json.dumps(entries).encode(),
+                )
+                manifest["translate"]["fields"][f_def["name"]] = len(entries)
+
+        put("manifest.json", json.dumps(manifest, indent=2).encode())
+
+    print(
+        f"backup: {index} -> {out_path}: {len(manifest['fragments'])} "
+        f"fragments, {total_bytes} frame bytes, "
+        f"{manifest['translate']['columns']} column keys, "
+        f"{sum(manifest['translate']['fields'].values())} row keys"
+    )
+    return 0
+
+
+def cmd_restore(args) -> int:
+    """Restore a backup tar into a (possibly different, possibly
+    resized) cluster (docs/resize.md).  Order matters: schema first (to
+    every node — apply_schema is idempotent), then translate entries (so
+    restored bitmaps decode under the same key→ID bindings they were
+    written with), then every fragment frame through the PUBLIC
+    import-roaring route — the coordinator fans each frame out to
+    whatever nodes own that shard under the CURRENT topology, each
+    owner adopting it via one group-committed WAL append, and 429
+    admission pushback is honored with Retry-After pacing."""
+    import tarfile
+
+    from pilosa_tpu.parallel.movement import fragment_checksum
+
+    _apply_skip_verify(args)
+    root = _base_uri(args.host)
+    with tarfile.open(args.path, "r") as tar:
+        names = tar.getnames()
+        prefix = names[0].split("/", 1)[0] if names else ""
+
+        def get(name: str) -> bytes:
+            f = tar.extractfile(f"{prefix}/{name}")
+            if f is None:
+                raise FileNotFoundError(f"{prefix}/{name} missing from tar")
+            return f.read()
+
+        manifest = json.loads(get("manifest.json"))
+        schema = json.loads(get("schema.json"))
+        source = manifest["index"]
+        target = args.rename or source
+        if target != source:
+            for idx_def in schema.get("indexes", []):
+                if idx_def["name"] == source:
+                    idx_def["name"] = target
+
+        status = _http("GET", root + "/status")
+        nodes = [
+            n["uri"].rstrip("/")
+            for n in status.get("nodes") or []
+            if n.get("uri")
+        ] or [root]
+
+        schema_body = json.dumps(schema).encode()
+        for uri in nodes:
+            _http("POST", uri + "/schema", schema_body)
+
+        applied_keys = 0
+        for member in names:
+            rel = member.split("/", 1)[1] if "/" in member else member
+            if not rel.startswith("translate/"):
+                continue
+            entries = json.loads(get(rel))
+            field = None
+            if rel.startswith("translate/field-"):
+                field = rel[len("translate/field-"):-len(".json")]
+            body: dict = {"index": target, "entries": entries}
+            if field:
+                body["field"] = field
+            payload = json.dumps(body).encode()
+            for uri in nodes:
+                _http("POST", uri + "/internal/translate/apply", payload)
+            applied_keys += len(entries)
+
+        restored = 0
+        mismatched = 0
+        for row in manifest["fragments"]:
+            data = get(
+                f"fragments/{row['field']}/{row['view']}/{row['shard']}"
+            )
+            if row.get("checksum") and fragment_checksum(data) != row["checksum"]:
+                mismatched += 1
+                print(
+                    f"restore: {row['field']}/{row['view']}/{row['shard']}: "
+                    "frame bytes do not match manifest checksum — "
+                    "tar corrupt, refusing to adopt",
+                    file=sys.stderr,
+                )
+                continue
+            _post_with_backoff(
+                f"{root}/index/{target}/field/{row['field']}"
+                f"/import-roaring/{row['shard']}?view={row['view']}",
+                data,
+                ctype="application/octet-stream",
+            )
+            restored += 1
+
+    print(
+        f"restore: {source} -> {target} on {root}: {restored} fragments, "
+        f"{applied_keys} translate keys, {mismatched} corrupt frame(s) skipped"
+    )
+    return 0 if mismatched == 0 else 1
+
+
 def _doctor_node_bundle(root: str, host_label: str, timeout: float) -> dict:
     """One node's full debug-surface bundle: the core routes plus a
     walk of the directory served by ``GET /debug/`` (so a debug
@@ -632,6 +899,32 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-request timeout seconds")
     s.add_argument("--json", action="store_true", help="raw JSON report")
     s.set_defaults(fn=cmd_replay)
+
+    s = sub.add_parser(
+        "backup",
+        help="back up one index (fragments + translate + schema) to a tar",
+    )
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="any cluster member; host:port or https://host:port")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
+    s.add_argument("-i", "--index", required=True)
+    s.add_argument("-o", "--out", default=None, metavar="FILE",
+                   help="output tar path (default: {index}.backup.tar)")
+    s.set_defaults(fn=cmd_backup)
+
+    s = sub.add_parser(
+        "restore",
+        help="restore a backup tar into a cluster (any topology)",
+    )
+    s.add_argument("path", help="backup tar written by `backup`")
+    s.add_argument("--host", default="127.0.0.1:10101",
+                   help="any cluster member; host:port or https://host:port")
+    s.add_argument("--tls-skip-verify", action="store_true",
+                   help="trust self-signed server certificates")
+    s.add_argument("--rename", default=None, metavar="NEW",
+                   help="restore under a different index name")
+    s.set_defaults(fn=cmd_restore)
 
     s = sub.add_parser(
         "doctor",

@@ -468,10 +468,23 @@ class InternalClient:
         )
         return raw
 
-    def fragment_inventory(self, uri: str, index: str) -> list[dict]:
-        """[{field, view, shard}] a peer holds for an index."""
-        resp = self._json("GET", uri, f"/internal/fragment/inventory?index={index}")
+    def fragment_inventory(
+        self, uri: str, index: str, checksums: bool = False
+    ) -> list[dict]:
+        """[{field, view, shard}] a peer holds for an index;
+        ``checksums=True`` adds each fragment's serialized-frame content
+        digest (the movement convergence witness — docs/resize.md)."""
+        path = f"/internal/fragment/inventory?index={index}"
+        if checksums:
+            path += "&checksums=1"
+        resp = self._json("GET", uri, path)
         return resp["fragments"]
+
+    def internal_status(self, uri: str) -> dict:
+        """Data-plane status: cluster state plus the per-fragment
+        content-checksum map — what anti-entropy and the resize bench
+        compare across owners to PROVE convergence (docs/resize.md)."""
+        return self._json("GET", uri, "/internal/status")
 
     # ------------------------------------------------------- translation
     def translate_entries(

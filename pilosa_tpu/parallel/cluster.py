@@ -36,6 +36,7 @@ from pilosa_tpu.parallel.resultwire import (  # noqa: F401 (re-exported)
 )
 from pilosa_tpu.parallel import resilience
 from pilosa_tpu.parallel.client import PeerError
+from pilosa_tpu.parallel.movement import MovementLane, fragment_checksum
 from pilosa_tpu.parallel.resilience import (
     DeadlineExceededError,
     make_resilient_client,
@@ -58,6 +59,13 @@ from pilosa_tpu.utils import durable, sanitize, tracing
 from pilosa_tpu.utils.tracing import GLOBAL_TRACER
 
 HEARTBEAT_INTERVAL = 2.0
+
+
+class RebalanceInFlightError(RuntimeError):
+    """A topology change raced an in-flight rebalance pull. Racing the
+    pull can drop the only holder of shards it is still fetching, so
+    node-remove surfaces the conflict (HTTP 409) instead — wait for
+    ``wait_rebalanced`` / the pull thread, then retry."""
 
 
 class _Leg:
@@ -359,6 +367,16 @@ class Cluster:
         self._announce_stamp: dict[tuple[str, str], int] = {}
         self._hb_timer: threading.Timer | None = None
         self._rebalance_thread: threading.Thread | None = None
+        # movement admission lane (docs/resize.md): EVERY bulk
+        # data-movement path — rebalance pulls, anti-entropy handoff
+        # pushes, restore adopts arriving via import-roaring — brackets
+        # its transfers here, so movement concurrency and byte rate are
+        # bounded cluster-wide instead of per-call-site
+        self.movement = MovementLane(
+            self.config.movement_max_concurrent,
+            self.config.movement_max_mbit,
+            stats=server.stats,
+        )
         self._import_exec = None  # lazy ThreadPoolExecutor for import fan-out
         self._import_exec_lock = sanitize.make_lock("Cluster._import_exec_lock")
         # bounded pool for the concurrent heartbeat /status sweep.
@@ -568,12 +586,13 @@ class Cluster:
         def rebalance():
             prev_state, self.state = self.state, STATE_RESIZING
             try:
-                self._pull_owned_fragments(
+                adopted = self._pull_owned_fragments(
                     [n for n in self._peers() if n.uri != uri]
                 )
             finally:
                 if self.state == STATE_RESIZING:
                     self.state = prev_state
+            self._warmup_adopted(adopted)
 
         t = threading.Thread(target=rebalance, daemon=True, name="join-rebalance")
         self._rebalance_thread = t
@@ -801,10 +820,11 @@ class Cluster:
             def rebalance():
                 prev_state, self.state = self.state, STATE_RESIZING
                 try:
-                    self._pull_owned_fragments(dropped + self._peers())
+                    adopted = self._pull_owned_fragments(dropped + self._peers())
                 finally:
                     if self.state == STATE_RESIZING:
                         self.state = prev_state
+                self._warmup_adopted(adopted)
 
             t = threading.Thread(
                 target=rebalance, daemon=True, name="adopt-rebalance"
@@ -872,48 +892,61 @@ class Cluster:
             except PeerError:
                 continue
             api.apply_schema(schema, validate=False)
-        self._pull_owned_fragments(self._peers())
+        self._warmup_adopted(self._pull_owned_fragments(self._peers()))
 
-    def _pull_owned_fragments(self, sources: list[Node]) -> None:
+    def _pull_owned_fragments(
+        self, sources: list[Node]
+    ) -> list[tuple[str, str, str, int]]:
         """Fetch every fragment this node owns under the CURRENT topology
         but does not hold locally, from the given source nodes (the data
-        movement half of the reference's ResizeJob)."""
-        api = self.server.api
+        movement half of the reference's ResizeJob). Whole fragments move
+        as serialized roaring frames through the movement admission lane
+        (docs/resize.md): per-source transfers run on a bounded worker
+        pool sized to the lane's slot count, each paying the byte-rate
+        throttle before its adopt. Returns the (index, field, view,
+        shard) list adopted FRESH — the residency warm-up input."""
+        adopted: list[tuple[str, str, str, int]] = []
         for src in sources:
+            jobs: list[tuple[str, str, str, int, str | None]] = []
             for idx in self.server.holder.schema():
                 idx_name = idx["name"]
                 try:
-                    inventory = self.client.fragment_inventory(src.uri, idx_name)
+                    inventory = self.client.fragment_inventory(
+                        src.uri, idx_name, checksums=True
+                    )
                 except PeerError:
                     continue
                 for frag_info in inventory:
                     shard = frag_info["shard"]
                     if not self.topology.owns(self.me.id, idx_name, shard):
                         continue
-                    field = frag_info["field"]
-                    view = frag_info["view"]
-                    # Merge even when a local fragment exists: a write
-                    # that raced in mid-join may have created it with
-                    # only the new bits — skipping would orphan the
-                    # source's older bits until anti-entropy. A missing
-                    # fragment takes the full-serialization fast path; an
-                    # existing one takes the block-checksum diff so a
-                    # routine restart doesn't re-download in-sync data.
-                    local = self._local_fragment(idx_name, field, view, shard)
-                    try:
-                        if local is None:
-                            data = self.client.retrieve_fragment(
-                                src.uri, idx_name, field, view, shard
-                            )
-                            api.import_roaring(
-                                idx_name, field, shard, data, view=view
-                            )
-                        else:
-                            self._sync_fragment(
-                                idx_name, field, view, shard, local, src
-                            )
-                    except PeerError:
-                        continue
+                    jobs.append((
+                        idx_name,
+                        frag_info["field"],
+                        frag_info["view"],
+                        shard,
+                        frag_info.get("checksum"),
+                    ))
+            if not jobs:
+                continue
+            workers = min(self.movement.max_concurrent, len(jobs))
+            if workers <= 1:
+                for job in jobs:
+                    self._pull_one_fragment(src, *job, adopted=adopted)
+            else:
+                from concurrent.futures import ThreadPoolExecutor
+
+                with ThreadPoolExecutor(
+                    max_workers=workers, thread_name_prefix="movement-pull"
+                ) as pool:
+                    list(
+                        pool.map(
+                            lambda j: self._pull_one_fragment(
+                                src, *j, adopted=adopted
+                            ),
+                            jobs,
+                        )
+                    )
         # the pull changed this node's holdings: publish the new
         # inventory so cached read routing points here without waiting
         # for the next heartbeat refresh
@@ -923,6 +956,140 @@ class Cluster:
                 {self.me.uri: sorted(idx_obj.available_shards())},
                 replace=True,
             )
+        return adopted
+
+    # serialized-frame transfers retry the SAME frame on 429 (the adopt
+    # is an idempotent union), honoring the peer's Retry-After — the
+    # loader's backoff discipline (docs/ingest.md), bounded so a peer
+    # stuck shedding load fails the transfer to the next AE pass
+    MOVEMENT_MAX_RETRIES_429 = 32
+
+    def _retrieve_with_backoff(
+        self, src: Node, index: str, field: str, view: str, shard: int
+    ) -> bytes:
+        for _ in range(self.MOVEMENT_MAX_RETRIES_429):
+            try:
+                return self.client.retrieve_fragment(
+                    src.uri, index, field, view, shard
+                )
+            except PeerError as e:
+                if not e.backpressure:
+                    raise
+                time.sleep(min(max(e.retry_after or 0.05, 0.01), 5.0))
+        raise PeerError(
+            src.uri,
+            f"fragment pull {index}/{field}/{view}/{shard}: still 429 "
+            f"after {self.MOVEMENT_MAX_RETRIES_429} attempts",
+            status=429,
+        )
+
+    def _import_roaring_with_backoff(
+        self, uri: str, index: str, field: str, view: str, shard: int,
+        data: bytes,
+    ) -> None:
+        for _ in range(self.MOVEMENT_MAX_RETRIES_429):
+            try:
+                self.client.import_roaring(uri, index, field, view, shard, data)
+                return
+            except PeerError as e:
+                if not e.backpressure:
+                    raise
+                time.sleep(min(max(e.retry_after or 0.05, 0.01), 5.0))
+        raise PeerError(
+            uri,
+            f"fragment push {index}/{field}/{view}/{shard}: still 429 "
+            f"after {self.MOVEMENT_MAX_RETRIES_429} attempts",
+            status=429,
+        )
+
+    def _pull_one_fragment(
+        self,
+        src: Node,
+        index: str,
+        field: str,
+        view: str,
+        shard: int,
+        src_checksum: str | None = None,
+        adopted: list | None = None,
+    ) -> None:
+        """One whole-fragment movement through the admission lane.
+        Merge even when a local fragment exists: a write that raced in
+        mid-join may have created it with only the new bits — skipping
+        would orphan the source's older bits until anti-entropy. A
+        missing fragment takes the serialized-frame bulk lane; an
+        existing one first compares content checksums (identical ⇒
+        nothing to move) and only then pays the block-checksum diff.
+        PeerError is swallowed — the next pass or source retries."""
+        api = self.server.api
+        local = self._local_fragment(index, field, view, shard)
+        if local is not None and src_checksum:
+            if fragment_checksum(serialize(local.bitmap)) == src_checksum:
+                return
+        try:
+            if local is None:
+                with self.movement.transfer(
+                    "pull", index, field, view, shard, peer=src.uri
+                ) as row:
+                    data = self._retrieve_with_backoff(
+                        src, index, field, view, shard
+                    )
+                    row["bytes"] = len(data)
+                    self.movement.throttle(len(data))
+                    api.import_roaring(index, field, shard, data, view=view)
+                    self.movement.account("pull", len(data))
+                if adopted is not None:
+                    adopted.append((index, field, view, shard))
+            else:
+                self._sync_fragment(index, field, view, shard, local, src)
+        except PeerError:
+            return
+
+    # warm-up breadth caps: enough to prime a new node's hot set, small
+    # enough that warm-up can't become a second resize's worth of work
+    WARMUP_MAX_FRAGMENTS = 64
+    WARMUP_ROWS_PER_FRAGMENT = 4
+
+    def _warmup_adopted(
+        self, adopted: list[tuple[str, str, str, int]]
+    ) -> None:
+        """Device-residency warm-up for freshly adopted shards: run each
+        fragment's leading rows through the LOCAL read path
+        PROMOTE_TOUCHES times, so the touch-driven promotion machinery
+        (executor/residency.py) lifts the new node's working set into
+        the device tier before client traffic lands on it cold.
+        Best-effort by design — a warm-up failure must never fail the
+        resize that triggered it."""
+        if not adopted:
+            return
+        from pilosa_tpu.executor import residency
+
+        api = self.server.api
+        for index, field, view, shard in adopted[: self.WARMUP_MAX_FRAGMENTS]:
+            if view != "standard" or field.startswith("_"):
+                continue  # internal fields aren't addressable as Row(f=)
+            idx = self.server.holder.index(index)
+            f = idx.field(field) if idx is not None else None
+            if f is None or f.options.field_type != "set" or f.options.keys:
+                # warm plain set fields only: BSI rows aren't queryable
+                # as Row(f=id), and keyed rows need a reverse translate
+                continue
+            frag = self._local_fragment(index, field, view, shard)
+            if frag is None:
+                continue
+            rows = list(frag.row_ids())[: self.WARMUP_ROWS_PER_FRAGMENT]
+            for row in rows:
+                for _ in range(residency.PROMOTE_TOUCHES):
+                    try:
+                        api.query(
+                            index,
+                            f"Count(Row({field}={int(row)}))",
+                            shards=[shard],
+                        )
+                    except Exception:  # pilosa: allow(broad-except) —
+                        # warm-up is advisory; the adopt already
+                        # committed, so any query-path error here is the
+                        # query path's problem, not the resize's
+                        return
 
     def _resolve_node(self, ident: str, uri: str | None = None) -> Node | None:
         """Find a topology node by id or URI. Ids are config-dependent
@@ -957,7 +1124,18 @@ class Cluster:
         is the target it enters the REMOVED state: client queries/imports
         are rejected, but /internal/* data-plane routes keep serving so
         survivors can drain its fragments. Returns False if the node is
-        unknown."""
+        unknown. An in-flight rebalance pull is a CONFLICT, not a race
+        to win: the pull derives its job list from the pre-remove
+        topology, so mutating membership under it can leave this node
+        missing fragments whose only holder just left — surface it
+        (RebalanceInFlightError → HTTP 409) and let the operator wait."""
+        t = self._rebalance_thread
+        if t is not None and t.is_alive():
+            raise RebalanceInFlightError(
+                f"node-remove {ident!r} refused: rebalance pull in "
+                f"flight ({t.name}) — wait_rebalanced() first, then "
+                "retry (progress: GET /debug/cluster)"
+            )
         node = self._resolve_node(ident, uri)
         if node is None:
             if uri:
@@ -1754,10 +1932,19 @@ class Cluster:
 
     def wait_rebalanced(self, timeout: float | None = None) -> None:
         """Block until the background join-rebalance pull (if any) has
-        finished — test/ops hook for deterministic growth sequencing."""
+        finished — test/ops hook for deterministic growth sequencing.
+        Raises a labeled ``TimeoutError`` when the pull is STILL RUNNING
+        at the deadline: the old silent return let callers proceed
+        against a half-populated node (reads routed there count zeros,
+        node-remove races the pull) with nothing to grep for."""
         t = self._rebalance_thread
         if t is not None:
             t.join(timeout)
+            if t.is_alive():
+                raise TimeoutError(
+                    f"rebalance pull still running after {timeout}s "
+                    f"({t.name}); transfer progress: GET /debug/cluster"
+                )
 
     def _translate_read_keys(self, index: str, call: Call) -> Call:
         """Rewrite string row keys to IDs before fan-out, consulting the
@@ -2686,15 +2873,24 @@ class Cluster:
             return False  # no current owners (shouldn't happen); keep the data
         v0 = frag.version
         data = serialize(frag.bitmap)
-        for owner in owners:
-            if not self._probe_alive(owner):
-                return False
-            try:
-                self.client.import_roaring(
-                    owner.uri, index, field, view_name, shard, data
-                )
-            except PeerError:
-                return False
+        # the push is movement too: same admission lane as rebalance
+        # pulls — one slot for the whole owner fan-out (the frame is
+        # shared), the byte throttle paid once per owner leg
+        with self.movement.transfer(
+            "push", index, field, view_name, shard
+        ) as mrow:
+            mrow["bytes"] = len(data)
+            for owner in owners:
+                if not self._probe_alive(owner):
+                    return False
+                self.movement.throttle(len(data))
+                try:
+                    self._import_roaring_with_backoff(
+                        owner.uri, index, field, view_name, shard, data
+                    )
+                except PeerError:
+                    return False
+                self.movement.account("push", len(data))
         # the re-check and the removal must be ONE atomic step under the
         # fragment write lock: a write (e.g. a re-forwarded import, which
         # applies locally on the old owner by design) landing between
@@ -2794,6 +2990,7 @@ class Cluster:
             ("GET", re.compile(r"^/internal/fragment/block/data$")): self._h_block_data,
             ("GET", re.compile(r"^/internal/fragment/data$")): self._h_fragment_data,
             ("GET", re.compile(r"^/internal/fragment/inventory$")): self._h_inventory,
+            ("GET", re.compile(r"^/internal/status$")): self._h_internal_status,
             (
                 "POST",
                 re.compile(r"^/internal/import/([^/]+)/([^/]+)$"),
@@ -3084,9 +3281,16 @@ class Cluster:
         node_id = body.get("id")
         if not node_id:
             raise ValueError("remove-node requires an 'id'")
-        removed = self.remove_node(
-            node_id, broadcast=body.get("broadcast", True), uri=body.get("uri")
-        )
+        try:
+            removed = self.remove_node(
+                node_id, broadcast=body.get("broadcast", True), uri=body.get("uri")
+            )
+        except RebalanceInFlightError as e:
+            # 409, not 500: the cluster is healthy — the admin request
+            # lost a conflict with in-flight data movement and is safe
+            # to retry once the pull drains
+            handler._json({"error": str(e)}, code=409)
+            return
         handler._json({"success": removed, "state": self.state})
 
     def _h_join(self, handler) -> None:
@@ -3103,16 +3307,57 @@ class Cluster:
 
     def _h_inventory(self, handler) -> None:
         index = handler.query_params["index"][0]
+        want_sums = handler.query_params.get("checksums", ["0"])[0] in (
+            "1", "true",
+        )
         idx = self.server.holder.index(index)
         frags = []
         if idx is not None:
             for f_name, f in idx.fields.items():
                 for v_name, view in f.views.items():
-                    for shard in view.fragments:
-                        frags.append(
-                            {"field": f_name, "view": v_name, "shard": shard}
-                        )
+                    for shard, frag in list(view.fragments.items()):
+                        row = {"field": f_name, "view": v_name, "shard": shard}
+                        if want_sums:
+                            # content digest over the serialized frame:
+                            # serialize run-compacts on the way out, so
+                            # equal logical content ⇒ equal digest — the
+                            # puller skips in-sync fragments without a
+                            # block-by-block diff (docs/resize.md)
+                            row["checksum"] = fragment_checksum(
+                                serialize(frag.bitmap)
+                            )
+                        frags.append(row)
         handler._json({"fragments": frags})
+
+    def fragment_checksums(self, index: str | None = None) -> dict:
+        """{index: {"field/view/shard": digest}} over every local
+        fragment — the convergence witness anti-entropy and the resize
+        bench compare across owners (served on /internal/status)."""
+        out: dict[str, dict[str, str]] = {}
+        for idx_name, idx in list(self.server.holder.indexes.items()):
+            if index is not None and idx_name != index:
+                continue
+            sums: dict[str, str] = {}
+            for f_name, f in list(idx.fields.items()):
+                for v_name, view in list(f.views.items()):
+                    for shard, frag in list(view.fragments.items()):
+                        sums[f"{f_name}/{v_name}/{shard}"] = fragment_checksum(
+                            serialize(frag.bitmap)
+                        )
+            out[idx_name] = sums
+        return out
+
+    def _h_internal_status(self, handler) -> None:
+        """Data-plane status: state + per-fragment content checksums.
+        Separate from the public /status heartbeat payload — computing
+        digests per heartbeat would tax every liveness probe."""
+        handler._json({
+            "state": self.state,
+            "localID": self.me.id,
+            "topologyEpoch": self.topology.epoch,
+            "checksums": self.fragment_checksums(),
+            "movement": self.movement.snapshot(),
+        })
 
     @staticmethod
     def _import_body(handler) -> dict:

@@ -326,6 +326,7 @@ class ResilientClient:
         "attr_block_data",
         "retrieve_fragment",
         "fragment_inventory",
+        "internal_status",
         "translate_entries",
         "translate_tail",
     })
@@ -384,8 +385,11 @@ class ResilientClient:
     def retrieve_fragment(self, uri, index, field, view, shard):
         return self._call("retrieve_fragment", uri, index, field, view, shard)
 
-    def fragment_inventory(self, uri, index):
-        return self._call("fragment_inventory", uri, index)
+    def fragment_inventory(self, uri, index, checksums=False):
+        return self._call("fragment_inventory", uri, index, checksums)
+
+    def internal_status(self, uri):
+        return self._call("internal_status", uri)
 
     def translate_entries(self, uri, index, field, offset, holes=None):
         return self._call("translate_entries", uri, index, field, offset, holes)

@@ -19,6 +19,7 @@ import numpy as np
 
 from pilosa_tpu import __version__
 from pilosa_tpu.core import (
+    EXISTENCE_FIELD,
     VIEW_STANDARD,
     Field,
     FieldOptions,
@@ -464,7 +465,18 @@ class API:
         — fsyncs amortize across concurrent importers instead of a full
         durable snapshot per post."""
         idx = self._index(index)
-        f = self._field(idx, field)
+        if field == EXISTENCE_FIELD:
+            # whole-fragment movement (rebalance pull, handoff push,
+            # restore) ships the internal existence field too, and the
+            # adopter may not have lazily created it yet — materialize
+            # it instead of failing the transfer (docs/resize.md)
+            f = idx.existence_field()
+            if f is None:
+                raise ExecutionError(
+                    f"index {index!r} does not track existence"
+                )
+        else:
+            f = self._field(idx, field)
         frag = f.create_view_if_not_exists(view).create_fragment_if_not_exists(shard)
         delta = frag.import_roaring(data)
         # existence marking from the DELTA (incoming positions), not the

@@ -68,6 +68,7 @@ _ROUTES: list[tuple[str, re.Pattern, str]] = [
     ("GET", re.compile(r"^/debug/profile$"), "debug_profile"),
     ("GET", re.compile(r"^/debug/saturation$"), "debug_saturation"),
     ("GET", re.compile(r"^/debug/processes$"), "debug_processes"),
+    ("GET", re.compile(r"^/debug/cluster$"), "debug_cluster"),
     ("GET", re.compile(r"^/debug/resources$"), "debug_resources"),
     ("GET", re.compile(r"^/debug/traces$"), "debug_traces"),
     ("GET", re.compile(r"^/debug/flightrec$"), "debug_flightrec"),
@@ -101,6 +102,7 @@ _DEBUG_ENDPOINTS: list[tuple[str, str, bool, str | None]] = [
     ("/debug/profile", "continuous profiler: folded flame-graph stacks (?seconds=N, ?segment=, ?format=speedscope|segments)", False, "?format=speedscope"),
     ("/debug/saturation", "USE verdict: event-loop lag, worker utilization, GIL estimate, lock contention (?window=S)", True, ""),
     ("/debug/processes", "multi-process fleet view: supervisor state + per-process saturation verdicts stitched over localhost (?window=S)", True, ""),
+    ("/debug/cluster", "cluster movement view: state, rebalance thread, per-transfer progress, throttle + throughput meter", True, ""),
     ("/debug/resources", "unified per-subsystem used/limit/pressure resource ledger", True, ""),
     ("/debug/flightrec", "retained slow/errored query evidence (?trace_id=, &format=perfetto)", True, ""),
     ("/debug/workload", "heavy-hitter fingerprints + cachability estimate (?top=, ?format=capture)", True, ""),
@@ -1160,6 +1162,35 @@ class Handler(BaseHTTPRequestHandler):
         with urllib.request.urlopen(req, timeout=timeout, context=ctx) as r:
             return json.loads(r.read() or b"{}")
 
+    def h_debug_cluster(self) -> None:
+        """The cluster movement view (docs/resize.md): cluster state +
+        topology epoch, whether a rebalance pull is in flight, every
+        IN-FLIGHT transfer's progress row (direction, fragment, peer,
+        bytes, age), recent completions, and the movement meter
+        (window Mbit/s, throttle waits) — the surface an operator
+        watches while adding or draining a node."""
+        cluster = getattr(self.api, "cluster", None)
+        if cluster is None:
+            # solo fallback, the /debug/processes precedent: the surface
+            # stays probeable (doctor bundles every /debug/ endpoint) and
+            # says there is no movement plane rather than erroring
+            self._json(snapshot_envelope({"clustered": False}))
+            return
+        t = cluster._rebalance_thread
+        self._json(
+            snapshot_envelope({
+                "clustered": True,
+                "state": cluster.state,
+                "localID": cluster.me.id,
+                "topologyEpoch": cluster.topology.epoch,
+                "rebalance": {
+                    "inFlight": bool(t is not None and t.is_alive()),
+                    "thread": t.name if t is not None else None,
+                },
+                "movement": cluster.movement.snapshot(),
+            })
+        )
+
     def h_debug_resources(self) -> None:
         """The unified resource ledger (docs/profiling.md): the byte
         accounting scattered across the codebase — device residency
@@ -1238,6 +1269,22 @@ class Handler(BaseHTTPRequestHandler):
                 windowSeconds=ing["windowSeconds"],
                 recentBytesPerS=ing["recentBytesPerS"],
                 recentMbitSetPerS=ing["recentMbitSetPerS"],
+            )
+        # movement lane (docs/resize.md): bulk data movement byte totals
+        # + window rate, with slot occupancy as the pressure fraction
+        cluster = getattr(self.api, "cluster", None)
+        if cluster is not None:
+            mv = cluster.movement.snapshot()
+            row(
+                "movement",
+                len(mv["active"]),
+                mv["maxConcurrent"],
+                "transfers",
+                bytesTotal=mv["meter"]["bytesTotal"],
+                fragmentsTotal=mv["meter"]["fragmentsTotal"],
+                throttleWaits=mv["meter"]["throttleWaits"],
+                recentMbitPerS=mv["meter"]["recentMbitPerS"],
+                maxMbit=mv["maxMbit"],
             )
         # evidence rings
         rec = getattr(self.server, "flightrec", None)

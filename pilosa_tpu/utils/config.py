@@ -158,6 +158,15 @@ class Config:
     # appends, snapshot writes, fsyncs, renames, dir-fsyncs), seeded by
     # the shared fault-seed; drives the disk-fault chaos suite
     fs_fault_rules: str = ""
+    # movement admission lane (docs/resize.md): bulk data movement —
+    # rebalance pulls, anti-entropy handoff pushes, restore adopts —
+    # holds one of this many concurrent transfer slots, so a resize
+    # can't monopolize the node's threads or the peer's import lane
+    movement_max_concurrent: int = 4
+    # aggregate movement byte-rate ceiling in megabits/second (token
+    # bucket with 1 s of burst); 0 = line rate. Lets an operator drain
+    # a node without starving serving traffic of bandwidth.
+    movement_max_mbit: float = 0.0
     # durability (docs/durability.md): when an ops-log append becomes
     # durable relative to the write acknowledgement. "always" fsyncs
     # inside every append; "batch" group-fsyncs all dirty WAL files once
@@ -418,6 +427,8 @@ def config_template() -> str:
         'fault-rules = ""\n'
         "fault-seed = 0\n"
         'fs-fault-rules = ""\n'
+        "movement-max-concurrent = 4\n"
+        "movement-max-mbit = 0.0\n"
         'wal-fsync-mode = "batch"\n'
         "compaction-workers = 1\n"
         "compaction-max-debt = 64\n"

@@ -1,0 +1,102 @@
+"""Crash-test child for the movement-lane kill-9 suite (tests/test_resize.py).
+
+Extends the durability chaos matrix (tests/_durability_child.py) to the
+cluster data-movement paths: whole-fragment frames adopted via ONE
+group-committed WAL append (docs/resize.md).  Phase 1 ingests local
+batches through the per-bit lane, ACKing each only after its durability
+barrier — those are the acknowledged writes that must survive.  Phase 2
+arms a seeded filesystem fault rule and adopts a whole-fragment frame
+the way a rebalance pull or a restore does; the rule SIGKILLs the
+process mid-adopt-append.  The parent reopens the holder, asserts zero
+acknowledged loss, re-adopts the same frame (idempotent union — the
+re-pull), and verifies convergence by content checksum against a
+fault-free oracle holder.
+
+Usage: python _movement_child.py <data_dir> <rules_json> <mode>
+
+``mode`` selects which movement path the adopt models:
+  pull     — new-replica hydration: the frame lands in a NEW fragment
+             (shard 1) that did not exist before the transfer
+  restore  — restore/rebalance sync: the frame unions into shard 0's
+             EXISTING fragment, on top of the acknowledged local bits
+
+Not collected by pytest (no ``test_`` prefix).
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("PILOSA_TPU_SHARD_WIDTH_EXP", "16")
+
+import numpy as np
+
+from pilosa_tpu.core import Holder
+from pilosa_tpu.parallel.faultinject import FSFaultInjector
+from pilosa_tpu.shardwidth import SHARD_WIDTH
+from pilosa_tpu.utils import durable
+
+BATCHES = 40
+BITS_PER_BATCH = 8
+
+
+def batch_bits(b: int) -> tuple[np.ndarray, np.ndarray]:
+    """Deterministic per-batch bit set (parent recomputes to verify
+    recovery).  Columns stay inside shard 0 at the test width."""
+    rows = np.full(BITS_PER_BATCH, b % 4, dtype=np.uint64)
+    cols = np.arange(
+        b * BITS_PER_BATCH, (b + 1) * BITS_PER_BATCH, dtype=np.uint64
+    )
+    return rows, cols
+
+
+def movement_frame(mode: str) -> tuple[int, bytes]:
+    """(shard, serialized roaring frame) the adopt phase moves — the
+    same deterministic frame the parent re-adopts and oracles against.
+    Restore-mode columns sit in shard 0's top half, disjoint from every
+    acked batch; pull-mode columns land in fresh shard 1."""
+    from pilosa_tpu.roaring import build as rb
+
+    shard = 0 if mode == "restore" else 1
+    base = shard * SHARD_WIDTH + SHARD_WIDTH // 2
+    cols = np.arange(base, base + 512, dtype=np.uint64)
+    rows = np.repeat(np.arange(4, dtype=np.uint64), 128)
+    payloads = rb.shard_payloads(rows, cols)
+    assert len(payloads) == 1 and payloads[0][0] == shard
+    return shard, payloads[0][1]
+
+
+def run(data_dir: str, rules, mode: str) -> int:
+    h = Holder(data_dir, compaction_workers=1)
+    h.open()
+    idx = h.create_index("i")
+    fld = idx.create_field("f")
+    view = fld.create_view_if_not_exists("standard")
+    frag0 = view.create_fragment_if_not_exists(0)
+    for b in range(BATCHES):
+        rows, cols = batch_bits(b)
+        fld.import_bulk(rows, cols)
+        durable.ack_barrier()
+        print(f"ACK {b}", flush=True)
+    # arm ONLY now: phase 1 is the acknowledged baseline; the very next
+    # fragment WAL append is the movement adopt the rule aims at
+    durable.install_fs_hook(FSFaultInjector(rules, seed=7))
+    shard, frame = movement_frame(mode)
+    frag = frag0 if shard == 0 else view.create_fragment_if_not_exists(shard)
+    frag.import_roaring(frame)
+    durable.ack_barrier()
+    print("ADOPTED", flush=True)  # unreachable when the rule kills
+    h.close()
+    return 0
+
+
+def main() -> int:
+    data_dir = sys.argv[1]
+    rules = json.loads(sys.argv[2])
+    mode = sys.argv[3] if len(sys.argv) > 3 else "pull"
+    durable.set_wal_fsync_mode("batch")
+    return run(data_dir, rules, mode)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,15 @@ write).  Enforced structurally:
    ``write=True`` on every ``_timed_query_node`` leg (the flag that
    routes around both the leg coalescer and the retry scope) and must
    never call the retried ``query_node``/``query_batch_node`` RPCs
-   directly.
+   directly;
+5. **movement rides the sanctioned chain** — the movement admission
+   lane (``parallel/movement.py``) is pure pacing/accounting and owns
+   no transport (no urllib/http.client/socket imports — a transfer
+   that talks to the network from inside the lane bypasses breakers
+   and fault injection), and the movement read RPCs
+   (retrieve_fragment, fragment_inventory, internal_status) stay IN
+   ``RETRYABLE_METHODS`` — dropping their retry coverage would turn
+   every transient fault during a rebalance into a failed pull.
 
 Files are located by project-relative suffix so tests can run the rule
 against fixtures and mutated copies of the tree.
@@ -43,6 +51,7 @@ CLIENT = "parallel/client.py"
 RESILIENCE = "parallel/resilience.py"
 FAULTINJECT = "parallel/faultinject.py"
 CLUSTER = "parallel/cluster.py"
+MOVEMENT = "parallel/movement.py"
 
 # construction of the raw transport is allowed only in these files
 _TRANSPORT_FILES = (CLIENT, RESILIENCE, FAULTINJECT)
@@ -58,6 +67,18 @@ _CANONICAL_WRITES = frozenset({
 # status is deliberately absent: the liveness probe is single-shot (the
 # heartbeat cadence is its retry loop — see parallel/resilience.py)
 _CANONICAL_READS = frozenset({"query_node", "query_batch_node"})
+
+# idempotent whole-frame movement reads (rebalance pulls, checksum
+# inventories, convergence status) — must keep retry/breaker coverage
+_MOVEMENT_READS = frozenset({
+    "retrieve_fragment",
+    "fragment_inventory",
+    "internal_status",
+})
+
+# the movement lane is pacing/accounting only — importing any of these
+# would mean a transfer path outside the resilient client chain
+_TRANSPORT_MODULES = ("urllib", "http.client", "socket")
 
 _WRITE_ROUTERS = ("_route_write", "_route_attr_write")
 
@@ -220,6 +241,45 @@ def check_resilience(project: Project) -> list[Violation]:
                         "whole queries",
                     )
                 )
+            missing_m = sorted(_MOVEMENT_READS - retryable)
+            if missing_m:
+                out.append(
+                    Violation(
+                        "resilience",
+                        res.rel,
+                        r_line,
+                        f"movement read RPC(s) {missing_m} missing from "
+                        "RETRYABLE_METHODS — rebalance pulls would lose "
+                        "retry/breaker coverage and every transient fault "
+                        "would fail the transfer",
+                    )
+                )
+
+    # 5: the movement lane owns no transport
+    movement = project.find(MOVEMENT)
+    if movement is not None and movement.tree is not None:
+        for node in ast.walk(movement.tree):
+            mods: list[tuple[str, int]] = []
+            if isinstance(node, ast.Import):
+                mods = [(a.name, node.lineno) for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                mods = [(node.module, node.lineno)]
+            for mod, lineno in mods:
+                if any(
+                    mod == t or mod.startswith(t + ".")
+                    for t in _TRANSPORT_MODULES
+                ):
+                    out.append(
+                        Violation(
+                            "resilience",
+                            movement.rel,
+                            lineno,
+                            f"movement lane imports transport module "
+                            f"{mod!r} — the lane is pacing/accounting "
+                            "only; transfers must go through the "
+                            "resilient client chain",
+                        )
+                    )
 
     # 4: write routers stay outside the retry scope
     cluster = project.find(CLUSTER)
